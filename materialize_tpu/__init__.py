@@ -1,0 +1,31 @@
+"""materialize_tpu — a TPU-native incremental-view-maintenance streaming SQL engine.
+
+A ground-up re-design of the capabilities of MaterializeInc/materialize
+(reference layer map: SURVEY.md §1) for TPU hardware:
+
+- The *data plane* — arrangement maintenance, join / reduce / top_k / MFP
+  kernels — runs as JAX/XLA programs over fixed-capacity columnar update
+  batches resident in HBM. Each dataflow "tick" is a single jitted function
+  ``state -> (state', outputs)``: no host↔device ping-pong inside a tick.
+- The *control plane* — progress tracking (frontiers/antichains), capability
+  logic, catalog, coordination — stays on the host, mirroring the reference's
+  split where timely's progress tracking is tiny next to its data plane
+  (reference: doc/developer/platform/architecture-db.md:40-108).
+
+Everything is built on the universal currency of the reference engine: update
+triples ``(row, time, diff)`` plus frontier statements (reference:
+doc/developer/change-data-capture.md:5-13), here laid out as structure-of-array
+device batches with diff==0 padding (padding annihilates under every IVM
+operator, so kernels compose without masks).
+"""
+
+import jax
+
+# The engine's core dtypes are u64 hashes/timestamps and i64 diffs, matching
+# the reference's `mz_repr::Timestamp` (u64 ms) and `Diff` (i64)
+# (reference: src/repr/src/timestamp.rs:46, src/repr/src/diff.rs:11).
+# On TPU, 64-bit integer ops are emulated on the 32-bit VPU; the hot kernels
+# keep 64-bit data off the critical path where possible.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

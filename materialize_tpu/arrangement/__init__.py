@@ -1,0 +1,3 @@
+from .spine import Arrangement, arrange_batch
+
+__all__ = ["Arrangement", "arrange_batch"]

@@ -1,0 +1,102 @@
+"""Arrangements: device-resident indexed state, maintained as an LSM spine.
+
+The TPU re-design of differential's `Spine`/`TraceReader` and the reference's
+`mz-row-spine` (src/row-spine/src/lib.rs:9-28): an arrangement is a list of
+consolidated, hash-sorted UpdateBatches of geometrically decreasing capacity.
+
+- batch build   = radix/lex sort by (hash, key, val, time)  [ops.consolidate]
+- batch merge   = concat + consolidate (one fused XLA program)
+- cursor lookup = vectorized binary search over the hash column [ops.join]
+
+Merge scheduling is driven by static capacities (powers of two), so merge
+decisions never need a host↔device sync; live counts are only read back when
+re-bucketing shrinks capacity after compaction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from ..ops.consolidate import advance_times, consolidate
+from ..repr.batch import UpdateBatch, bucket_cap
+from ..repr.hashing import hash_columns
+
+
+def arrange_batch(batch: UpdateBatch, key_cols: tuple[int, ...]) -> UpdateBatch:
+    """Key a raw batch by the given val-column indices and canonicalize it.
+
+    The analogue of the ArrangeBy LIR operator's batch construction
+    (reference: src/compute/src/render.rs:1303). Key columns are *copied*
+    into `keys` (vals stay the full row) and the hash is recomputed.
+    """
+    keys = tuple(batch.vals[i] for i in key_cols)
+    if keys:
+        hashes = hash_columns(keys)
+        # preserve padding: dead rows keep PAD via diff==0 after consolidate
+        hashes = jnp.where(batch.live, hashes, batch.hashes)
+    else:
+        hashes = jnp.where(batch.live, jnp.zeros_like(batch.hashes), batch.hashes)
+    keyed = UpdateBatch(hashes, keys, batch.vals, batch.times, batch.diffs)
+    return consolidate(keyed)
+
+
+@dataclass
+class Arrangement:
+    """Host handle to spine state. `key_cols` indexes into the row (val) columns."""
+
+    key_cols: tuple[int, ...]
+    batches: list[UpdateBatch] = field(default_factory=list)
+    since: int = 0  # logical compaction frontier
+
+    def insert(self, delta: UpdateBatch, already_keyed: bool = False) -> None:
+        """Add a delta batch (raw, keyed on the fly) and restore the merge invariant."""
+        b = delta if already_keyed else arrange_batch(delta, self.key_cols)
+        self.batches.append(b)
+        self._maintain()
+
+    def _maintain(self) -> None:
+        # Merge while the tail batch is at least half the size of its
+        # predecessor (geometric spine, amortized O(log) merges per insert).
+        while len(self.batches) >= 2 and (
+            self.batches[-1].cap * 2 >= self.batches[-2].cap
+        ):
+            b = self.batches.pop()
+            a = self.batches.pop()
+            merged = consolidate(
+                advance_times(UpdateBatch.concat(a, b), self.since)
+            )
+            self.batches.append(merged.with_capacity(bucket_cap(a.cap + b.cap)))
+
+    def compact(self, since: int) -> None:
+        """Advance the logical compaction frontier (AllowCompaction;
+        reference: src/compute/src/compute_state.rs:732)."""
+        self.since = max(self.since, since)
+
+    def rebucket(self) -> None:
+        """Shrink capacities to fit live counts (host sync; call occasionally)."""
+        new = []
+        for b in self.batches:
+            n = int(b.count())
+            cap = bucket_cap(n)
+            if cap < b.cap:
+                b = consolidate(b).with_capacity(cap)
+            new.append(b)
+        self.batches = [b for b in new]
+        self._maintain()
+
+    def merged(self) -> UpdateBatch:
+        """One consolidated batch of the full contents (snapshot reads/peeks)."""
+        if not self.batches:
+            return UpdateBatch.empty(8)
+        out = self.batches[0]
+        for b in self.batches[1:]:
+            out = UpdateBatch.concat(out, b)
+        return consolidate(advance_times(out, self.since))
+
+    def count(self) -> int:
+        return sum(int(b.count()) for b in self.batches)
+
+    def total_cap(self) -> int:
+        return sum(b.cap for b in self.batches)

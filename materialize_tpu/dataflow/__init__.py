@@ -1,0 +1,5 @@
+from . import plan
+from .plan import BuildDesc, DataflowDescription
+from .runtime import Dataflow
+
+__all__ = ["plan", "BuildDesc", "DataflowDescription", "Dataflow"]

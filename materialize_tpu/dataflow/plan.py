@@ -1,0 +1,176 @@
+"""LIR: the physical dataflow plan the renderer executes.
+
+Mirrors the reference's `RenderPlan` operator set
+(src/compute-types/src/plan/render_plan.rs:130 — Constant / Get / Mfp /
+FlatMap / Join / Reduce / TopK / Negate / Threshold / Union / ArrangeBy) and
+`DataflowDescription` (src/compute-types/src/dataflows.rs:32). Plans are
+host-side ADTs; rendering turns each node into a stateful operator driving
+jitted kernels (see runtime.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..expr.linear import MapFilterProject
+from ..ops.reduce import AggregateExpr
+from ..ops.topk import TopKPlan
+
+# ---------------------------------------------------------------------------
+# plan expressions (one per LIR operator)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Constant:
+    """Literal collection: rows as (data tuple, time, diff)."""
+
+    rows: tuple
+    dtypes: tuple  # np dtype per column
+
+
+@dataclass(frozen=True)
+class Get:
+    """Reference a source import, an index import, or a previously-built object."""
+
+    id: str
+
+
+@dataclass(frozen=True)
+class Mfp:
+    input: Any
+    mfp: MapFilterProject
+
+
+@dataclass(frozen=True)
+class FlatMap:
+    """Table function application (unnest etc.); func is host-registered."""
+
+    input: Any
+    func: str
+    exprs: tuple = ()
+
+
+@dataclass(frozen=True)
+class JoinStage:
+    """One binary stage of a linear join chain.
+
+    stream_key: column indices into the accumulated (left) row.
+    lookup_key: column indices into the joined input's row.
+    """
+
+    stream_key: tuple[int, ...]
+    lookup_key: tuple[int, ...]
+
+
+@dataclass(frozen=True)
+class LinearJoinPlan:
+    """Binary join chain over inputs in order (reference: plan/join.rs linear).
+
+    stages[i] joins the accumulated stream with inputs[i+1].
+    """
+
+    stages: tuple[JoinStage, ...]
+
+
+@dataclass(frozen=True)
+class DeltaPathStage:
+    """One half-join lookup of a delta path (reference: delta_join.rs:51)."""
+
+    other_input: int
+    stream_key: tuple[int, ...]  # cols into the accumulated stream row
+    lookup_key: tuple[int, ...]  # cols into the other input's row
+
+
+@dataclass(frozen=True)
+class DeltaJoinPlan:
+    """One path per input; update streams flow through the other inputs'
+    arrangements without new intermediate state (plan/join/delta_join.rs:10-17)."""
+
+    paths: tuple[tuple[DeltaPathStage, ...], ...]
+    # paths[k] starts from input k's delta; column order of the final output
+    # is given by permute[k]: per-path projection to canonical column order
+    permutations: tuple[tuple[int, ...], ...]
+
+
+@dataclass(frozen=True)
+class Join:
+    inputs: tuple
+    plan: Any  # LinearJoinPlan | DeltaJoinPlan
+    closure: Optional[MapFilterProject] = None  # applied to concatenated rows
+
+
+@dataclass(frozen=True)
+class Reduce:
+    """Accumulable (sum/count) and/or hierarchical (min/max) aggregates.
+
+    Mirrors ReducePlan (src/compute-types/src/plan/reduce.rs:130); collation of
+    mixed aggregate kinds is planned by the SQL layer as a join of reduces.
+    """
+
+    input: Any
+    key_cols: tuple[int, ...]
+    aggs: tuple[AggregateExpr, ...] = ()
+    distinct: bool = False  # ReducePlan::Distinct
+
+
+@dataclass(frozen=True)
+class HierarchicalReduce:
+    """MIN/MAX per group via the topk kernel (k=1 per aggregate)."""
+
+    input: Any
+    key_cols: tuple[int, ...]
+    agg_col: int
+    is_max: bool
+
+
+@dataclass(frozen=True)
+class TopK:
+    input: Any
+    plan: TopKPlan
+
+
+@dataclass(frozen=True)
+class Negate:
+    input: Any
+
+
+@dataclass(frozen=True)
+class Threshold:
+    input: Any
+
+
+@dataclass(frozen=True)
+class Union:
+    inputs: tuple
+
+
+@dataclass(frozen=True)
+class ArrangeBy:
+    input: Any
+    key_cols: tuple[int, ...]
+
+
+# ---------------------------------------------------------------------------
+# dataflow description
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BuildDesc:
+    id: str
+    plan: Any
+    dtypes: tuple  # output column dtypes
+
+
+@dataclass
+class DataflowDescription:
+    """What to build: mirrors dataflows.rs:32 (source_imports, objects_to_build,
+    index_exports, sink_exports, as_of)."""
+
+    source_imports: dict  # id -> RelationDesc/dtypes
+    objects_to_build: list  # list[BuildDesc] in dependency order
+    index_exports: dict  # index id -> (object id, key_cols)
+    sink_exports: dict = field(default_factory=dict)  # sink id -> object id
+    as_of: int = 0

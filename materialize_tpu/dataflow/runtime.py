@@ -1,0 +1,549 @@
+"""Render LIR plans into stateful operators and drive them tick by tick.
+
+The host-side analogue of the reference's render + compute_state machinery
+(src/compute/src/render.rs:202 `build_compute_dataflow`,
+render.rs:1155 `render_plan_expr`, compute_state.rs:86): the control plane —
+operator graph, frontier bookkeeping, state capacity management — lives here
+in Python; every batch of actual data work is a jitted XLA program from
+materialize_tpu.ops.
+
+Per tick, every collection produces an optional delta `(oks, errs)`; `None`
+means "no change", which lets quiet subgraphs skip kernel dispatch entirely
+(the analogue of timely operators not being scheduled without capabilities).
+Both oks and errs follow the twin-collection error design of
+src/compute/src/render.rs:30-101.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..arrangement.spine import Arrangement, arrange_batch
+from ..ops.consolidate import consolidate
+from ..ops.join import join_against
+from ..ops.reduce import AccumState, accumulable_step
+from ..ops.threshold import threshold_step
+from ..ops.topk import negate as negate_batch
+from ..ops.topk import topk_step
+from ..repr.batch import UpdateBatch, bucket_cap
+from . import plan as lir
+
+ERR_DTYPES = (np.dtype(np.int64),)
+
+Delta = Optional[tuple[Optional[UpdateBatch], Optional[UpdateBatch]]]
+
+
+def _union(parts: list[UpdateBatch]) -> Optional[UpdateBatch]:
+    parts = [p for p in parts if p is not None]
+    if not parts:
+        return None
+    acc = parts[0]
+    for p in parts[1:]:
+        acc = UpdateBatch.concat(acc, p)
+    return consolidate(acc)
+
+
+def _project(batch: UpdateBatch, cols: tuple[int, ...]) -> UpdateBatch:
+    return UpdateBatch(
+        batch.hashes, (), tuple(batch.vals[i] for i in cols), batch.times, batch.diffs
+    )
+
+
+class Node:
+    """One rendered LIR operator."""
+
+    def step(self, tick: int, ins: list[Delta]) -> Delta:
+        raise NotImplementedError
+
+    def compact(self, since: int) -> None:
+        pass
+
+
+class ConstantNode(Node):
+    def __init__(self, expr: lir.Constant):
+        self.rows = expr.rows
+        self.dtypes = expr.dtypes
+        self.emitted = False
+
+    def step(self, tick, ins):
+        if self.emitted:
+            return None
+        pending = [r for r in self.rows if r[1] <= tick]
+        if not pending:
+            return None
+        self.emitted = all(r[1] <= tick for r in self.rows)
+        cols = tuple(
+            np.array([r[0][i] for r in pending], dtype=self.dtypes[i])
+            for i in range(len(self.dtypes))
+        )
+        times = np.array([max(r[1], tick) for r in pending], dtype=np.uint64)
+        diffs = np.array([r[2] for r in pending], dtype=np.int64)
+        return UpdateBatch.build((), cols, times, diffs), None
+
+
+class MfpNode(Node):
+    def __init__(self, mfp):
+        self.mfp = mfp
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        if self.mfp.is_identity():
+            return oks, errs
+        out, new_errs = self.mfp.apply(oks)
+        return out, _union([errs, new_errs])
+
+
+class NegateNode(Node):
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        return (negate_batch(oks) if oks is not None else None), errs
+
+
+class UnionNode(Node):
+    def step(self, tick, ins):
+        oks = _union([d[0] for d in ins if d is not None])
+        errs = _union([d[1] for d in ins if d is not None])
+        if oks is None and errs is None:
+            return None
+        return oks, errs
+
+
+class ArrangeByNode(Node):
+    def __init__(self, key_cols: tuple[int, ...]):
+        self.arr = Arrangement(key_cols=key_cols)
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is not None:
+            self.arr.insert(oks)
+        return oks, errs
+
+    def compact(self, since):
+        self.arr.compact(since)
+
+
+class LinearJoinNode(Node):
+    """Binary join chain; each stage keeps arrangements of both sides
+    (the differential `join_core` shape, linear_join.rs)."""
+
+    def __init__(self, jplan: lir.LinearJoinPlan, closure):
+        self.stages = jplan.stages
+        self.closure = closure
+        self.state: list[tuple[Arrangement, Arrangement]] = [
+            (Arrangement(key_cols=s.stream_key), Arrangement(key_cols=s.lookup_key))
+            for s in self.stages
+        ]
+
+    def _binary(self, stage_i: int, dl: Optional[UpdateBatch], dr: Optional[UpdateBatch]):
+        stage = self.stages[stage_i]
+        left_arr, right_arr = self.state[stage_i]
+        outs = []
+        dlk = arrange_batch(dl, stage.stream_key) if dl is not None else None
+        drk = arrange_batch(dr, stage.lookup_key) if dr is not None else None
+        if dlk is not None:
+            outs += join_against(dlk, right_arr.batches)
+        if drk is not None:
+            outs += join_against(drk, left_arr.batches, swap=True)
+        if dlk is not None and drk is not None:
+            outs += join_against(dlk, [drk])  # arrange_batch consolidated drk
+        if dlk is not None:
+            left_arr.insert(dlk, already_keyed=True)
+        if drk is not None:
+            right_arr.insert(drk, already_keyed=True)
+        return _union(outs)
+
+    def step(self, tick, ins):
+        errs = _union([d[1] for d in ins if d is not None])
+        stream = ins[0][0] if ins[0] is not None else None
+        for i in range(len(self.stages)):
+            right = ins[i + 1][0] if ins[i + 1] is not None else None
+            stream = self._binary(i, stream, right)
+        if stream is None and errs is None:
+            return None
+        if stream is not None and self.closure is not None:
+            stream, cerrs = self.closure.apply(stream)
+            errs = _union([errs, cerrs])
+        return stream, errs
+
+    def compact(self, since):
+        for l, r in self.state:
+            l.compact(since)
+            r.compact(since)
+
+
+class DeltaJoinNode(Node):
+    """Delta join: one update path per input, streaming through the other
+    inputs' arrangements with no intermediate state (delta_join.rs:51).
+
+    Per tick, paths are processed in input order; input k's delta is inserted
+    into k's arrangements after path k runs, so path k sees inputs j<k
+    up-to-date and inputs j>k as of the previous paths — the sequential-update
+    decomposition that half_join realizes with per-update time comparison.
+    """
+
+    def __init__(self, jplan: lir.DeltaJoinPlan, closure, n_inputs: int):
+        self.plan = jplan
+        self.closure = closure
+        self.arrs: dict[tuple[int, tuple[int, ...]], Arrangement] = {}
+        for path in jplan.paths:
+            for st in path:
+                key = (st.other_input, st.lookup_key)
+                if key not in self.arrs:
+                    self.arrs[key] = Arrangement(key_cols=st.lookup_key)
+
+    def step(self, tick, ins):
+        errs = _union([d[1] for d in ins if d is not None])
+        outs = []
+        for k, path in enumerate(self.plan.paths):
+            dk = ins[k][0] if ins[k] is not None else None
+            stream = dk
+            for st in path:
+                if stream is None:
+                    break
+                probe = arrange_batch(stream, st.stream_key)
+                arr = self.arrs[(st.other_input, st.lookup_key)]
+                stream = _union(join_against(probe, arr.batches))
+            if stream is not None:
+                outs.append(_project(stream, self.plan.permutations[k]))
+            # now publish input k's delta to its arrangements
+            if dk is not None:
+                for (inp, key), arr in self.arrs.items():
+                    if inp == k:
+                        arr.insert(arrange_batch(dk, key), already_keyed=True)
+        out = _union(outs)
+        if out is None and errs is None:
+            return None
+        if out is not None and self.closure is not None:
+            out, cerrs = self.closure.apply(out)
+            errs = _union([errs, cerrs])
+        return out, errs
+
+    def compact(self, since):
+        for arr in self.arrs.values():
+            arr.compact(since)
+
+
+class ReduceNode(Node):
+    def __init__(self, expr: lir.Reduce, in_dtypes: tuple):
+        self.key_cols = expr.key_cols
+        self.aggs = expr.aggs
+        key_dtypes = tuple(in_dtypes[i] for i in expr.key_cols)
+        accum_dtypes = tuple(np.dtype(a.accum_dtype) for a in expr.aggs)
+        self.state = AccumState.empty(8, key_dtypes, accum_dtypes)
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        self.state, out, agg_errs = accumulable_step(
+            self.state, oks, self.key_cols, self.aggs, tick
+        )
+        n = int(self.state.count())
+        if bucket_cap(n) < self.state.cap:
+            self.state = self.state.with_capacity(bucket_cap(n))
+        return out, _union([errs, agg_errs])
+
+
+class DistinctNode(Node):
+    """ReducePlan::Distinct — project to key cols, then presence per row."""
+
+    def __init__(self, key_cols: tuple[int, ...], in_dtypes: tuple):
+        self.key_cols = key_cols
+        key_dtypes = tuple(in_dtypes[i] for i in key_cols)
+        self.state = AccumState.empty(8, key_dtypes, ())
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        projected = _project(oks, self.key_cols)
+        self.state, out = threshold_step(self.state, projected, "distinct", tick)
+        return out, errs
+
+
+class ThresholdNode(Node):
+    def __init__(self, in_dtypes: tuple):
+        self.state = AccumState.empty(8, tuple(in_dtypes), ())
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        self.state, out = threshold_step(self.state, oks, "threshold", tick)
+        return out, errs
+
+
+class TopKNode(Node):
+    def __init__(self, tplan):
+        self.plan = tplan
+        self.arr = Arrangement(key_cols=tplan.group_cols)
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        keyed = arrange_batch(oks, self.plan.group_cols)
+        out = topk_step(self.arr, keyed, self.plan, tick)
+        return out, errs
+
+    def compact(self, since):
+        self.arr.compact(since)
+
+
+# ---------------------------------------------------------------------------
+# dataflow
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _Rendered:
+    node: Node
+    input_ids: list  # each is an id (str) or nested _Rendered
+
+
+class Dataflow:
+    """A rendered dataflow: drive with `step`, read indexes with `peek`.
+
+    The tick loop is the host analogue of the timely worker loop
+    (src/compute/src/server.rs:356): advance the input frontier, flow deltas
+    through the operator DAG in dependency order, update exported traces.
+    """
+
+    def __init__(self, desc: lir.DataflowDescription):
+        self.desc = desc
+        self.builds: list = []  # (obj_id, [(node, input_refs)], out_ref)
+        self.dtypes: dict[str, tuple] = {}
+        for sid, dts in desc.source_imports.items():
+            self.dtypes[sid] = tuple(dts)
+        for bd in desc.objects_to_build:
+            ops = []
+            out_ref = self._render(bd.plan, ops)
+            self.builds.append((bd.id, ops, out_ref))
+            self.dtypes[bd.id] = tuple(bd.dtypes)
+        self.index_traces: dict[str, Arrangement] = {}
+        self.index_errs: dict[str, Arrangement] = {}
+        for idx_id, (obj_id, key_cols) in desc.index_exports.items():
+            self.index_traces[idx_id] = Arrangement(key_cols=tuple(key_cols))
+            self.index_errs[idx_id] = Arrangement(key_cols=())
+        self.sink_outputs: dict[str, list] = {s: [] for s in desc.sink_exports}
+        self.frontier = desc.as_of
+
+    # -- rendering ---------------------------------------------------------
+    def _render(self, expr, ops: list):
+        """Append (node, input_refs) entries; return a ref (int = op index,
+        str = imported/built id)."""
+        e = expr
+        if isinstance(e, lir.Get):
+            return e.id
+        if isinstance(e, lir.Constant):
+            ops.append((ConstantNode(e), []))
+            return len(ops) - 1
+        if isinstance(e, lir.Mfp):
+            ref = self._render(e.input, ops)
+            ops.append((MfpNode(e.mfp), [ref]))
+            return len(ops) - 1
+        if isinstance(e, lir.Negate):
+            ref = self._render(e.input, ops)
+            ops.append((NegateNode(), [ref]))
+            return len(ops) - 1
+        if isinstance(e, lir.Union):
+            refs = [self._render(i, ops) for i in e.inputs]
+            ops.append((UnionNode(), refs))
+            return len(ops) - 1
+        if isinstance(e, lir.ArrangeBy):
+            ref = self._render(e.input, ops)
+            ops.append((ArrangeByNode(e.key_cols), [ref]))
+            return len(ops) - 1
+        if isinstance(e, lir.Join):
+            refs = [self._render(i, ops) for i in e.inputs]
+            if isinstance(e.plan, lir.LinearJoinPlan):
+                ops.append((LinearJoinNode(e.plan, e.closure), refs))
+            else:
+                ops.append((DeltaJoinNode(e.plan, e.closure, len(refs)), refs))
+            return len(ops) - 1
+        if isinstance(e, lir.Reduce):
+            ref = self._render(e.input, ops)
+            in_dt = self._infer_dtypes(e.input)
+            if e.distinct:
+                ops.append((DistinctNode(e.key_cols, in_dt), [ref]))
+            else:
+                ops.append((ReduceNode(e, in_dt), [ref]))
+            return len(ops) - 1
+        if isinstance(e, lir.Threshold):
+            ref = self._render(e.input, ops)
+            ops.append((ThresholdNode(self._infer_dtypes(e.input)), [ref]))
+            return len(ops) - 1
+        if isinstance(e, lir.TopK):
+            ref = self._render(e.input, ops)
+            ops.append((TopKNode(e.plan), [ref]))
+            return len(ops) - 1
+        raise NotImplementedError(f"render: {type(e).__name__}")
+
+    def _infer_dtypes(self, expr) -> tuple:
+        """Column dtypes of a plan expression (for state initialization)."""
+        e = expr
+        if isinstance(e, lir.Get):
+            return self.dtypes[e.id]
+        if isinstance(e, lir.Constant):
+            return tuple(e.dtypes)
+        if isinstance(e, lir.Mfp):
+            ins = self._infer_dtypes(e.input)
+            cols = list(ins)
+            for m in e.mfp.map_exprs:
+                cols.append(_expr_dtype(m, cols))
+            if e.mfp.projection is not None:
+                cols = [cols[i] for i in e.mfp.projection]
+            return tuple(cols)
+        if isinstance(e, (lir.Negate, lir.Threshold, lir.ArrangeBy)):
+            return self._infer_dtypes(e.input)
+        if isinstance(e, lir.Union):
+            return self._infer_dtypes(e.inputs[0])
+        if isinstance(e, lir.TopK):
+            return self._infer_dtypes(e.input)
+        if isinstance(e, lir.Reduce):
+            ins = self._infer_dtypes(e.input)
+            if e.distinct:
+                return tuple(ins[i] for i in e.key_cols)
+            return tuple(ins[i] for i in e.key_cols) + tuple(
+                np.dtype(a.accum_dtype) for a in e.aggs
+            )
+        if isinstance(e, lir.Join):
+            cols = []
+            for i in e.inputs:
+                cols.extend(self._infer_dtypes(i))
+            if e.closure is not None and e.closure.projection is not None:
+                base = list(cols)
+                for m in e.closure.map_exprs:
+                    base.append(_expr_dtype(m, base))
+                cols = [base[i] for i in e.closure.projection]
+            return tuple(cols)
+        raise NotImplementedError(f"dtypes: {type(e).__name__}")
+
+    # -- execution ---------------------------------------------------------
+    def step(self, tick: int, source_deltas: dict[str, UpdateBatch]) -> dict:
+        """Advance to `tick`, flowing the given source deltas through the DAG.
+
+        Returns {exported id: (oks delta, errs delta) or None}.
+        """
+        env: dict[str, Delta] = {}
+        for sid, batch in source_deltas.items():
+            env[sid] = (batch, None)
+        results: dict[str, Delta] = {}
+        for obj_id, ops, out_ref in self.builds:
+            slots: list[Delta] = []
+            for node, in_refs in ops:
+                ins = [
+                    (env.get(r) if isinstance(r, str) else slots[r]) for r in in_refs
+                ]
+                slots.append(node.step(tick, ins))
+            out = env.get(out_ref) if isinstance(out_ref, str) else slots[out_ref]
+            env[obj_id] = out
+            results[obj_id] = out
+        for idx_id, (obj_id, _k) in self.desc.index_exports.items():
+            d = results.get(obj_id)
+            if d is not None:
+                oks, errs = d
+                if oks is not None:
+                    self.index_traces[idx_id].insert(oks)
+                if errs is not None:
+                    self.index_errs[idx_id].insert(errs)
+        for sink_id, obj_id in self.desc.sink_exports.items():
+            d = results.get(obj_id)
+            if d is not None and d[0] is not None:
+                self.sink_outputs[sink_id].append((tick, d[0]))
+        self.frontier = tick + 1
+        return results
+
+    def peek(self, index_id: str, at: Optional[int] = None) -> list[tuple]:
+        """Snapshot read of an exported index at time `at` (default: latest
+        complete time). The analogue of PendingPeek::Index cursor scans
+        (src/compute/src/compute_state.rs:1273)."""
+        at = self.frontier - 1 if at is None else at
+        err_rows = [
+            r
+            for r in self.index_errs[index_id].merged().to_rows()
+            if r[1] <= at and r[2] != 0
+        ]
+        acc: dict[tuple, int] = {}
+        for data, t, d in err_rows:
+            if t <= at:
+                acc[data] = acc.get(data, 0) + d
+        if any(v > 0 for v in acc.values()):
+            raise RuntimeError(f"peek {index_id}: error collection non-empty: {acc}")
+        out: dict[tuple, int] = {}
+        for data, t, d in self.index_traces[index_id].merged().to_rows():
+            if t <= at:
+                out[data] = out.get(data, 0) + d
+        rows = []
+        for data, cnt in sorted(out.items()):
+            rows.extend([data] * cnt)
+        return rows
+
+    def compact(self, since: int) -> None:
+        for _obj, ops, _ref in self.builds:
+            for node, _ins in ops:
+                node.compact(since)
+        for arr in self.index_traces.values():
+            arr.compact(since)
+
+
+def _expr_dtype(expr, col_dtypes):
+    """Static result dtype of a scalar expr given input column dtypes."""
+    from ..expr import scalar as s
+
+    if isinstance(expr, s.Column):
+        return np.dtype(col_dtypes[expr.index])
+    if isinstance(expr, s.Literal):
+        return np.dtype(expr.dtype)
+    if isinstance(expr, s.CallUnary):
+        if expr.func in ("cast_int64",):
+            return np.dtype(np.int64)
+        if expr.func in ("cast_int32",):
+            return np.dtype(np.int32)
+        if expr.func in ("cast_float",):
+            return np.dtype(np.float32)
+        if expr.func in ("not", "is_true"):
+            return np.dtype(np.bool_)
+        return _expr_dtype(expr.expr, col_dtypes)
+    if isinstance(expr, s.CallBinary):
+        if expr.func in ("eq", "ne", "lt", "lte", "gt", "gte"):
+            return np.dtype(np.bool_)
+        lt_ = _expr_dtype(expr.left, col_dtypes)
+        rt = _expr_dtype(expr.right, col_dtypes)
+        return np.promote_types(lt_, rt)
+    if isinstance(expr, s.CallVariadic):
+        if expr.func in ("and", "or"):
+            return np.dtype(np.bool_)
+        dts = [_expr_dtype(e, col_dtypes) for e in expr.exprs]
+        out = dts[0]
+        for d in dts[1:]:
+            out = np.promote_types(out, d)
+        return out
+    raise TypeError(f"not a ScalarExpr: {expr!r}")

@@ -1,0 +1,23 @@
+from .linear import MapFilterProject
+from .scalar import (
+    CallBinary,
+    CallUnary,
+    CallVariadic,
+    Column,
+    EvalErr,
+    Literal,
+    eval_expr,
+    expr_columns,
+)
+
+__all__ = [
+    "MapFilterProject",
+    "CallBinary",
+    "CallUnary",
+    "CallVariadic",
+    "Column",
+    "EvalErr",
+    "Literal",
+    "eval_expr",
+    "expr_columns",
+]

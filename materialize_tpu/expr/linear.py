@@ -1,0 +1,121 @@
+"""MapFilterProject — the fused row-level operator.
+
+The TPU analogue of the reference's `MapFilterProject`/`MfpPlan`
+(src/expr/src/linear.rs:45): appended map expressions, a conjunction of
+predicates, then a projection — evaluated as ONE columnwise XLA program per
+batch. Filtered rows keep their slot with diff=0 (diff-annihilation is the
+engine-wide padding discipline, see repr.batch); erroring rows are routed to
+a parallel error batch instead of trapping, per the reference's oks/errs twin
+dataflow design (src/compute/src/render.rs:30-101).
+
+Convention: a collection's row columns are always `batch.vals` in relation
+order; `batch.keys`/`batch.hashes` are an arrangement artifact (copies of key
+columns) managed by arrange/exchange, not by MFP.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from ..repr.batch import PAD_TIME, UpdateBatch
+from ..repr.hashing import PAD_HASH
+from .scalar import ScalarExpr, eval_expr, expr_columns
+
+
+@dataclass(frozen=True)
+class MapFilterProject:
+    input_arity: int
+    map_exprs: tuple = ()  # appended columns, may reference earlier maps
+    predicates: tuple = ()  # conjunction; references input+map columns
+    projection: tuple | None = None  # output col indices; None = identity
+
+    @staticmethod
+    def identity(arity: int) -> "MapFilterProject":
+        return MapFilterProject(arity)
+
+    @property
+    def output_arity(self) -> int:
+        if self.projection is not None:
+            return len(self.projection)
+        return self.input_arity + len(self.map_exprs)
+
+    def is_identity(self) -> bool:
+        return (
+            not self.map_exprs
+            and not self.predicates
+            and (
+                self.projection is None
+                or tuple(self.projection) == tuple(range(self.input_arity))
+            )
+        )
+
+    def apply(self, batch: UpdateBatch) -> tuple[UpdateBatch, UpdateBatch]:
+        """Evaluate on a batch; returns (oks, errs).
+
+        errs has vals=(err_code,) and inherits time/diff from the failing rows;
+        rows without error are inert there (diff 0).
+        """
+        cols = list(batch.vals)
+        n = batch.cap
+        err = jnp.zeros((n,), dtype=jnp.int32)
+        for e in self.map_exprs:
+            v, ev = eval_expr(e, cols, n)
+            err = jnp.maximum(err, ev)
+            cols.append(v)
+
+        keep = jnp.ones((n,), dtype=jnp.bool_)
+        for p in self.predicates:
+            v, ev = eval_expr(p, cols, n)
+            err = jnp.maximum(err, ev)
+            keep = keep & v.astype(jnp.bool_)
+
+        live = batch.live
+        err = jnp.where(live, err, 0)  # padding can't error
+        ok_mask = keep & (err == 0)
+
+        out_cols = cols if self.projection is None else [cols[i] for i in self.projection]
+        ok_diffs = jnp.where(ok_mask, batch.diffs, 0)
+        oks = UpdateBatch(
+            hashes=jnp.where(ok_mask & live, batch.hashes, PAD_HASH),
+            keys=(),
+            vals=tuple(out_cols),
+            times=jnp.where(ok_mask & live, batch.times, PAD_TIME),
+            diffs=ok_diffs,
+        )
+        # keys are an arrangement artifact; a projected batch is raw again
+        err_mask = err != 0
+        errs = UpdateBatch(
+            hashes=jnp.where(err_mask, jnp.zeros_like(batch.hashes), PAD_HASH),
+            keys=(),
+            vals=(err.astype(jnp.int64),),
+            times=jnp.where(err_mask, batch.times, PAD_TIME),
+            diffs=jnp.where(err_mask, batch.diffs, 0),
+        )
+        return oks, errs
+
+    def demanded_columns(self) -> set[int]:
+        """Input columns the MFP actually reads (for projection pushdown)."""
+        arity = self.input_arity
+        demanded: set[int] = set()
+        exprs = list(self.map_exprs) + list(self.predicates)
+        if self.projection is not None:
+            for i in self.projection:
+                if i < arity:
+                    demanded.add(i)
+                else:
+                    exprs.append(self.map_exprs[i - arity])
+        else:
+            demanded |= set(range(arity))
+        for e in exprs:
+            for c in expr_columns(e):
+                if c < arity:
+                    demanded.add(c)
+                # columns >= arity are maps; their deps are walked because all
+                # map exprs are included above
+        for e in self.map_exprs:
+            for c in expr_columns(e):
+                if c < arity:
+                    demanded.add(c)
+        return demanded

@@ -1,0 +1,187 @@
+"""Scalar expressions evaluated columnwise on device.
+
+The TPU analogue of the reference's `MirScalarExpr`
+(src/expr/src/scalar.rs:69) and its Unary/Binary/Variadic function enums
+(src/expr/src/scalar/func/macros.rs): an expression tree compiles to a pure
+JAX computation over column arrays, vectorized across the batch. Runtime
+errors (division by zero, …) do not trap: they produce a per-row error code
+that the MFP routes into the dataflow's error stream, mirroring the
+reference's oks/errs twin collections (src/compute/src/render.rs:30-101).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class EvalErr(enum.IntEnum):
+    """Per-row evaluation error codes (0 = no error)."""
+
+    NONE = 0
+    DIVISION_BY_ZERO = 1
+    NUMERIC_OVERFLOW = 2
+
+
+@dataclass(frozen=True)
+class Column:
+    """Reference to input column `index` (after maps: index into input+maps)."""
+
+    index: int
+
+
+@dataclass(frozen=True)
+class Literal:
+    value: Any
+    dtype: str = "int64"  # numpy dtype name
+
+
+@dataclass(frozen=True)
+class CallUnary:
+    func: str  # neg | not | abs | is_true | cast_int64 | cast_float
+    expr: Any
+
+
+@dataclass(frozen=True)
+class CallBinary:
+    func: str  # add sub mul div floordiv mod eq ne lt lte gt gte and or min max
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class CallVariadic:
+    func: str  # and | or | greatest | least
+    exprs: tuple
+
+
+ScalarExpr = Any  # Column | Literal | CallUnary | CallBinary | CallVariadic
+
+
+def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
+    """Evaluate to (value_array[n], err_code_array[n] int32)."""
+    zero_err = jnp.zeros((n,), dtype=jnp.int32)
+    if isinstance(expr, Column):
+        return cols[expr.index], zero_err
+    if isinstance(expr, Literal):
+        v = jnp.full((n,), expr.value, dtype=np.dtype(expr.dtype))
+        return v, zero_err
+    if isinstance(expr, CallUnary):
+        v, e = eval_expr(expr.expr, cols, n)
+        if expr.func == "neg":
+            return -v, e
+        if expr.func == "not":
+            return ~v, e
+        if expr.func == "abs":
+            return jnp.abs(v), e
+        if expr.func == "is_true":
+            return v.astype(jnp.bool_), e
+        if expr.func == "cast_int64":
+            return v.astype(jnp.int64), e
+        if expr.func == "cast_int32":
+            return v.astype(jnp.int32), e
+        if expr.func == "cast_float":
+            return v.astype(jnp.float32), e
+        raise NotImplementedError(f"unary func {expr.func}")
+    if isinstance(expr, CallBinary):
+        lv, le = eval_expr(expr.left, cols, n)
+        rv, re_ = eval_expr(expr.right, cols, n)
+        err = jnp.maximum(le, re_)
+        f = expr.func
+        if f == "add":
+            return lv + rv, err
+        if f == "sub":
+            return lv - rv, err
+        if f == "mul":
+            return lv * rv, err
+        if f in ("div", "floordiv"):
+            zero = rv == 0
+            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            if jnp.issubdtype(jnp.result_type(lv, rv), jnp.floating):
+                out = lv / safe
+            else:
+                # SQL integer division truncates toward zero; lax floordiv
+                # floors, so compute on magnitudes and restore sign.
+                q = jnp.abs(lv) // jnp.abs(safe)
+                out = jnp.where((lv < 0) ^ (safe < 0), -q, q)
+            err = jnp.where(zero, jnp.int32(EvalErr.DIVISION_BY_ZERO), err)
+            return out, err
+        if f == "mod":
+            zero = rv == 0
+            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            out = lv - safe * (
+                jnp.where((lv < 0) ^ (safe < 0), -(jnp.abs(lv) // jnp.abs(safe)), jnp.abs(lv) // jnp.abs(safe))
+            )
+            err = jnp.where(zero, jnp.int32(EvalErr.DIVISION_BY_ZERO), err)
+            return out, err
+        if f == "eq":
+            return lv == rv, err
+        if f == "ne":
+            return lv != rv, err
+        if f == "lt":
+            return lv < rv, err
+        if f == "lte":
+            return lv <= rv, err
+        if f == "gt":
+            return lv > rv, err
+        if f == "gte":
+            return lv >= rv, err
+        if f == "and":
+            return lv & rv, err
+        if f == "or":
+            return lv | rv, err
+        if f == "min":
+            return jnp.minimum(lv, rv), err
+        if f == "max":
+            return jnp.maximum(lv, rv), err
+        raise NotImplementedError(f"binary func {f}")
+    if isinstance(expr, CallVariadic):
+        vals, errs = zip(*(eval_expr(e, cols, n) for e in expr.exprs))
+        err = errs[0]
+        for e in errs[1:]:
+            err = jnp.maximum(err, e)
+        f = expr.func
+        if f == "and":
+            out = vals[0]
+            for v in vals[1:]:
+                out = out & v
+            return out, err
+        if f == "or":
+            out = vals[0]
+            for v in vals[1:]:
+                out = out | v
+            return out, err
+        if f == "greatest":
+            out = vals[0]
+            for v in vals[1:]:
+                out = jnp.maximum(out, v)
+            return out, err
+        if f == "least":
+            out = vals[0]
+            for v in vals[1:]:
+                out = jnp.minimum(out, v)
+            return out, err
+        raise NotImplementedError(f"variadic func {f}")
+    raise TypeError(f"not a ScalarExpr: {expr!r}")
+
+
+def expr_columns(expr: ScalarExpr) -> set[int]:
+    """Set of input column indices an expression references (for demand analysis)."""
+    if isinstance(expr, Column):
+        return {expr.index}
+    if isinstance(expr, Literal):
+        return set()
+    if isinstance(expr, CallUnary):
+        return expr_columns(expr.expr)
+    if isinstance(expr, CallBinary):
+        return expr_columns(expr.left) | expr_columns(expr.right)
+    if isinstance(expr, CallVariadic):
+        out: set[int] = set()
+        for e in expr.exprs:
+            out |= expr_columns(e)
+        return out
+    raise TypeError(f"not a ScalarExpr: {expr!r}")

@@ -1,0 +1,3 @@
+from .consolidate import advance_times, consolidate
+
+__all__ = ["advance_times", "consolidate"]

@@ -1,0 +1,81 @@
+"""Consolidation: sort updates and sum diffs of identical (key, val, time) rows.
+
+The TPU analogue of differential's `consolidate_updates` and of spine batch
+merging (reference hot loop list: SURVEY.md §3.2) — ONE fused XLA program:
+lexsort by (hash, keys…, vals…, time), segmented prefix-sum of diffs over
+equal-row runs, annihilated (diff==0) rows masked to padding and compacted to
+the front by a stable sort. O(n log n) on the MXU-adjacent sort units, no
+host round-trip.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..repr.batch import PAD_TIME, UpdateBatch
+from ..repr.hashing import PAD_HASH
+
+
+def row_equal_prev(cols) -> jnp.ndarray:
+    """eq[i] = all columns equal between row i and i-1 (eq[0] = False).
+
+    Shared run-detection primitive for every sorted-run kernel (consolidate,
+    accumulator merge, distinct-keys). Columns are canonicalized via _cmp_view.
+    """
+    eq = None
+    for raw in cols:
+        c = _cmp_view(raw)
+        e = c[1:] == c[:-1]
+        eq = e if eq is None else (eq & e)
+    return jnp.concatenate([jnp.zeros((1,), dtype=jnp.bool_), eq])
+
+
+@jax.jit
+def consolidate(batch: UpdateBatch) -> UpdateBatch:
+    """Canonicalize a batch: sorted, one row per (key,val,time), no zero diffs.
+
+    Padding rows sort last (PAD_HASH) and keep diff 0, so they fold into one
+    run that is masked back out. Output has the same capacity.
+    """
+    cap = batch.cap
+    order = jnp.lexsort(batch.sort_cols())
+    b = batch.permute(order)
+
+    cmp_cols = [b.hashes, *b.keys, *b.vals, b.times]
+    same = row_equal_prev(cmp_cols)
+    run_start = ~same
+    seg = jnp.cumsum(run_start.astype(jnp.int32)) - 1
+    sums = jax.ops.segment_sum(b.diffs, seg, num_segments=cap)
+    diff_out = jnp.where(run_start, sums[seg], 0)
+
+    live = run_start & (diff_out != 0) & (b.hashes != PAD_HASH)
+    hashes = jnp.where(live, b.hashes, PAD_HASH)
+    keys = tuple(jnp.where(live, k, jnp.zeros_like(k)) for k in b.keys)
+    vals = tuple(jnp.where(live, v, jnp.zeros_like(v)) for v in b.vals)
+    times = jnp.where(live, b.times, PAD_TIME)
+    diffs = jnp.where(live, diff_out, 0)
+
+    # Compact live rows to the front, preserving canonical order.
+    perm = jnp.argsort(~live, stable=True)
+    return UpdateBatch(hashes, keys, vals, times, diffs).permute(perm)
+
+
+def _cmp_view(c: jnp.ndarray) -> jnp.ndarray:
+    if c.dtype == jnp.bool_:
+        return c.astype(jnp.int32)
+    return c
+
+
+@jax.jit
+def advance_times(batch: UpdateBatch, since: jnp.ndarray):
+    """Logical compaction: forward every live time to at least `since`.
+
+    Mirrors differential trace compaction under an advanced `since` frontier
+    (reference: allow_compaction, src/compute/src/compute_state.rs:732). After
+    advancing, `consolidate` can cancel updates that now share a timestamp.
+    """
+    since = jnp.asarray(since, dtype=jnp.uint64)
+    is_pad = batch.times == PAD_TIME
+    new_times = jnp.where(is_pad, batch.times, jnp.maximum(batch.times, since))
+    return UpdateBatch(batch.hashes, batch.keys, batch.vals, new_times, batch.diffs)

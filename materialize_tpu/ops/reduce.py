@@ -1,0 +1,286 @@
+"""Accumulable reductions (SUM / COUNT family) as segmented device kernels.
+
+The TPU analogue of the reference's Accumulable reduce plan
+(src/compute/src/render/reduce.rs:2067-2268 `Accum` semigroup): per-key state
+is a sorted singleton table of accumulator vectors; a tick's delta batch is
+segment-summed into per-key contributions, merged into the table, and the
+output delta is emitted self-correctingly as (-old_aggregate, +new_aggregate)
+per affected key — pairs that didn't change cancel in consolidation.
+
+MIN/MAX (hierarchical) and general "basic" reductions live in topk.py /
+hierarchical kernels; AVG etc. are planned as SUM+COUNT plus a post-MFP,
+exactly as the reference plans them (src/compute-types/src/plan/reduce.rs:130).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..expr.scalar import ScalarExpr, eval_expr
+from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
+from ..repr.hashing import PAD_HASH, hash_columns
+
+# Beyond this many distinct keys sharing one 64-bit hash, lookups would miss;
+# with a uniform hash this needs ~2^32 keys per hash bucket to matter.
+_MAX_HASH_COLLISIONS = 4
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class AccumState:
+    """Per-key accumulators: one row per live key, sorted by (hash, keys)."""
+
+    hashes: jnp.ndarray  # u64 [cap], PAD_HASH = padding
+    keys: tuple  # key columns [cap]
+    accums: tuple  # one accumulator column per aggregate [cap]
+    nrows: jnp.ndarray  # i64 [cap] — group size (sum of diffs)
+
+    def tree_flatten(self):
+        return (self.hashes, self.keys, self.accums, self.nrows), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def cap(self) -> int:
+        return int(self.hashes.shape[0])
+
+    @property
+    def live(self) -> jnp.ndarray:
+        return self.hashes != PAD_HASH
+
+    def count(self) -> jnp.ndarray:
+        return jnp.sum(self.live.astype(jnp.int32))
+
+    @staticmethod
+    def empty(cap: int, key_dtypes, accum_dtypes) -> "AccumState":
+        return AccumState(
+            hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint64),
+            keys=tuple(jnp.zeros((cap,), dtype=dt) for dt in key_dtypes),
+            accums=tuple(jnp.zeros((cap,), dtype=dt) for dt in accum_dtypes),
+            nrows=jnp.zeros((cap,), dtype=jnp.int64),
+        )
+
+    @staticmethod
+    def concat(a: "AccumState", b: "AccumState") -> "AccumState":
+        return AccumState(
+            jnp.concatenate([a.hashes, b.hashes]),
+            tuple(jnp.concatenate([x, y]) for x, y in zip(a.keys, b.keys)),
+            tuple(jnp.concatenate([x, y]) for x, y in zip(a.accums, b.accums)),
+            jnp.concatenate([a.nrows, b.nrows]),
+        )
+
+    def with_capacity(self, cap: int) -> "AccumState":
+        cur = self.cap
+        if cap == cur:
+            return self
+        if cap < cur:
+            return AccumState(
+                self.hashes[:cap],
+                tuple(k[:cap] for k in self.keys),
+                tuple(a[:cap] for a in self.accums),
+                self.nrows[:cap],
+            )
+        pad = cap - cur
+
+        def ext(a, fill):
+            return jnp.concatenate([a, jnp.full((pad,), fill, dtype=a.dtype)])
+
+        return AccumState(
+            ext(self.hashes, PAD_HASH),
+            tuple(ext(k, 0) for k in self.keys),
+            tuple(ext(a, 0) for a in self.accums),
+            ext(self.nrows, 0),
+        )
+
+
+@dataclass(frozen=True)
+class AggregateExpr:
+    """One aggregate: func in {sum, count}; expr evaluated over the input row.
+
+    Mirrors the accumulable subset of the reference's `AggregateFunc`
+    (src/expr/src/relation/func.rs:1878).
+    """
+
+    func: str
+    expr: ScalarExpr
+    accum_dtype: str = "int64"
+
+
+@jax.jit
+def consolidate_accums(s: AccumState) -> AccumState:
+    """Sort by (hash, keys), sum accumulators of equal keys, drop empty groups."""
+    cap = s.cap
+    cols = [*(k for k in reversed(s.keys)), s.hashes]
+    order = jnp.lexsort(cols)
+    s = AccumState(
+        s.hashes[order],
+        tuple(k[order] for k in s.keys),
+        tuple(a[order] for a in s.accums),
+        s.nrows[order],
+    )
+    from .consolidate import row_equal_prev
+
+    run_start = ~row_equal_prev((s.hashes, *s.keys))
+    seg = jnp.cumsum(run_start.astype(jnp.int32)) - 1
+    accums = tuple(
+        jnp.where(run_start, jax.ops.segment_sum(a, seg, num_segments=cap)[seg], 0)
+        for a in s.accums
+    )
+    nrows = jnp.where(run_start, jax.ops.segment_sum(s.nrows, seg, num_segments=cap)[seg], 0)
+    nonzero = nrows != 0
+    for a in accums:
+        nonzero = nonzero | (a != 0)
+    live = run_start & nonzero & (s.hashes != PAD_HASH)
+    hashes = jnp.where(live, s.hashes, PAD_HASH)
+    keys = tuple(jnp.where(live, k, jnp.zeros_like(k)) for k in s.keys)
+    accums = tuple(jnp.where(live, a, jnp.zeros_like(a)) for a in accums)
+    nrows = jnp.where(live, nrows, 0)
+    perm = jnp.argsort(~live, stable=True)
+    return AccumState(
+        hashes[perm],
+        tuple(k[perm] for k in keys),
+        tuple(a[perm] for a in accums),
+        nrows[perm],
+    )
+
+
+@partial(jax.jit, static_argnames=("key_cols", "aggs"))
+def _contributions(delta: UpdateBatch, key_cols: tuple[int, ...], aggs):
+    """Per-row aggregate contributions of a raw delta batch (unconsolidated).
+
+    Returns (AccumState, err_batch): rows whose aggregate input expression
+    errors (e.g. division by zero) contribute nothing and are routed to the
+    error batch, per the oks/errs twin-stream design.
+    """
+    cols = list(delta.vals)
+    n = delta.cap
+    keys = tuple(delta.vals[i] for i in key_cols)
+    if keys:
+        hashes = jnp.where(delta.live, hash_columns(keys), PAD_HASH)
+    else:
+        hashes = jnp.where(delta.live, jnp.zeros_like(delta.hashes), PAD_HASH)
+    err = jnp.zeros((n,), dtype=jnp.int32)
+    accums = []
+    for agg in aggs:
+        if agg.func == "count":
+            accums.append(delta.diffs.astype(np.dtype(agg.accum_dtype)))
+        elif agg.func == "sum":
+            v, ev = eval_expr(agg.expr, cols, n)
+            err = jnp.maximum(err, ev)
+            dt = np.dtype(agg.accum_dtype)
+            accums.append(v.astype(dt) * delta.diffs.astype(dt))
+        else:
+            raise NotImplementedError(f"accumulable agg {agg.func}")
+    err = jnp.where(delta.live, err, 0)
+    ok = delta.live & (err == 0)
+    nrows = jnp.where(ok, delta.diffs, 0)
+    accums = tuple(jnp.where(ok, a, jnp.zeros_like(a)) for a in accums)
+    hashes = jnp.where(ok, hashes, PAD_HASH)
+    err_mask = err != 0
+    errs = UpdateBatch(
+        hashes=jnp.where(err_mask, jnp.zeros_like(delta.hashes), PAD_HASH),
+        keys=(),
+        vals=(err.astype(jnp.int64),),
+        times=jnp.where(err_mask, delta.times, PAD_TIME),
+        diffs=jnp.where(err_mask, delta.diffs, 0),
+    )
+    return AccumState(hashes, keys, accums, nrows), errs
+
+
+@jax.jit
+def lookup_accums(state: AccumState, probe: AccumState):
+    """Gather state entries matching probe keys.
+
+    Returns (found[bool], accums tuple, nrows) aligned with probe rows.
+    Handles up to _MAX_HASH_COLLISIONS distinct keys per 64-bit hash.
+    """
+    lo = jnp.searchsorted(state.hashes, probe.hashes, side="left")
+    hi = jnp.searchsorted(state.hashes, probe.hashes, side="right")
+    found = jnp.zeros(probe.hashes.shape, dtype=jnp.bool_)
+    idx = jnp.zeros(probe.hashes.shape, dtype=lo.dtype)
+    for off in range(_MAX_HASH_COLLISIONS):
+        cand = jnp.clip(lo + off, 0, state.cap - 1)
+        eq = (lo + off) < hi
+        for pk, sk in zip(probe.keys, state.keys):
+            eq = eq & (pk == sk[cand])
+        eq = eq & probe.live
+        take = eq & ~found
+        idx = jnp.where(take, cand, idx)
+        found = found | eq
+    accums = tuple(jnp.where(found, a[idx], 0) for a in state.accums)
+    nrows = jnp.where(found, state.nrows[idx], 0)
+    return found, accums, nrows
+
+
+@jax.jit
+def _emit_output(
+    delta_keys: AccumState,
+    old_accums,
+    old_nrows,
+    time: jnp.ndarray,
+) -> UpdateBatch:
+    """Self-correcting output: -old aggregate row, +new aggregate row per key.
+
+    delta_keys holds the *delta* contributions; new = old + delta. Output rows
+    are (key cols ++ one col per aggregate), diff ±1 at `time`.
+    """
+    cap = delta_keys.cap
+    live = delta_keys.live
+    new_accums = tuple(o + d for o, d in zip(old_accums, delta_keys.accums))
+    new_nrows = old_nrows + delta_keys.nrows
+
+    old_present = live & (old_nrows > 0)
+    new_present = live & (new_nrows > 0)
+
+    def interleave(a, b):
+        return jnp.stack([a, b], axis=1).reshape(-1)
+
+    hashes = interleave(
+        jnp.where(old_present, delta_keys.hashes, PAD_HASH),
+        jnp.where(new_present, delta_keys.hashes, PAD_HASH),
+    )
+    # output rows are raw (key cols ++ aggregate cols in vals); keys stay an
+    # arrangement artifact and are left empty
+    vals = tuple(interleave(k, k) for k in delta_keys.keys) + tuple(
+        interleave(o, n) for o, n in zip(old_accums, new_accums)
+    )
+    t = jnp.asarray(time, dtype=jnp.uint64)
+    times = interleave(
+        jnp.where(old_present, t, PAD_TIME), jnp.where(new_present, t, PAD_TIME)
+    )
+    diffs = interleave(
+        jnp.where(old_present, -1, 0).astype(jnp.int64),
+        jnp.where(new_present, 1, 0).astype(jnp.int64),
+    )
+    return UpdateBatch(hashes, (), vals, times, diffs)
+
+
+def accumulable_step(
+    state: AccumState,
+    delta: UpdateBatch,
+    key_cols: tuple[int, ...],
+    aggs: tuple[AggregateExpr, ...],
+    time: int,
+):
+    """One tick of an accumulable reduce: (state, Δin, t) → (state', Δout, Δerrs).
+
+    Host driver around jitted kernels; Δout is consolidated (no-op pairs
+    cancel). Rows whose aggregate input expression errors land in Δerrs.
+    Capacity of state grows as needed; callers rebucket occasionally.
+    """
+    raw_contrib, errs = _contributions(delta, key_cols, aggs)
+    contrib = consolidate_accums(raw_contrib)
+    _found, old_accums, old_nrows = lookup_accums(state, contrib)
+    out = _emit_output(contrib, old_accums, old_nrows, time)
+    from .consolidate import consolidate  # local import to avoid cycle
+
+    out = consolidate(out)
+    new_state = consolidate_accums(AccumState.concat(state, contrib))
+    return new_state, out, errs

@@ -1,0 +1,54 @@
+"""Threshold and Distinct: per-row multiplicity clamping.
+
+The reference's Threshold operator computes ``t(r) = max(r, 0)`` over diffs
+(src/compute/src/render/threshold.rs) and Distinct is the ReducePlan::Distinct
+case (render/reduce.rs). Both are multiplicity maps ``m -> f(m)`` over the
+per-row running count, so they share one kernel: keep a per-(full row) count
+table (AccumState with no accumulators), and on each tick emit
+``f(new_count) - f(old_count)`` for every touched row.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..repr.batch import PAD_TIME, UpdateBatch
+from ..repr.hashing import PAD_HASH
+from .consolidate import consolidate
+from .reduce import AccumState, _contributions, consolidate_accums, lookup_accums
+
+
+def _multiplicity(mode: str, counts: jnp.ndarray) -> jnp.ndarray:
+    if mode == "distinct":
+        return (counts > 0).astype(jnp.int64)
+    if mode == "threshold":
+        return jnp.maximum(counts, 0)
+    raise ValueError(mode)
+
+
+def threshold_step(
+    state: AccumState,
+    delta: UpdateBatch,
+    mode: str,
+    time: int,
+):
+    """One tick: (count_state, Δin, t) → (state', Δout) with Δout diffs
+    f(new_count) − f(old_count) per touched row. Row columns are the key."""
+    all_cols = tuple(range(len(delta.vals)))
+    raw_contrib, _errs = _contributions(delta, all_cols, ())
+    contrib = consolidate_accums(raw_contrib)
+    _found, _accs, old_n = lookup_accums(state, contrib)
+    new_n = old_n + contrib.nrows
+    out_d = _multiplicity(mode, new_n) - _multiplicity(mode, old_n)
+    live = contrib.live & (out_d != 0)
+    t = jnp.asarray(time, dtype=jnp.uint64)
+    out = UpdateBatch(
+        hashes=jnp.where(live, contrib.hashes, PAD_HASH),
+        keys=(),
+        vals=contrib.keys,  # the full row was the key
+        times=jnp.where(live, t, PAD_TIME),
+        diffs=jnp.where(live, out_d, 0),
+    )
+    new_state = consolidate_accums(AccumState.concat(state, contrib))
+    return new_state, consolidate(out)

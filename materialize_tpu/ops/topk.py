@@ -1,0 +1,213 @@
+"""TopK / MIN / MAX: affected-group recompute via segmented sort + rank window.
+
+The TPU analogue of the reference's hierarchical top_k and min/max reductions
+(src/compute/src/render/top_k.rs:61, render/reduce.rs Hierarchical). Where the
+reference bounds per-update cost with a 16-ary tower of thinning stages
+(doc/developer/arrangements.md:100-135), the TPU design exploits batch
+parallelism instead: a tick touches many groups at once, so we gather the
+*full contents of every affected group* from the input arrangement (two-pass
+sized vectorized binary-search gather), rank rows per group with one
+segmented sort, and window by [offset, offset+k) over a segmented running sum
+of multiplicities — no per-row expansion of diffs. Output deltas are emitted
+self-correctingly: new_topk − old_topk, computed against the arrangement
+before and after inserting the tick's delta.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
+from ..repr.hashing import PAD_HASH
+from .consolidate import advance_times, consolidate, row_equal_prev
+
+
+@dataclass(frozen=True)
+class TopKPlan:
+    """Mirrors the reference's TopKPlan (src/compute-types/src/plan/top_k.rs:28).
+
+    order_by: tuple of (val column index, descending) pairs.
+    limit None = no limit (offset-only); k is required for the kernel path.
+    """
+
+    group_cols: tuple[int, ...]
+    order_by: tuple[tuple[int, bool], ...]
+    limit: int | None
+    offset: int = 0
+
+
+@jax.jit
+def distinct_keys(delta_keyed: UpdateBatch) -> UpdateBatch:
+    """Distinct (hash, key) probes of a keyed batch: one live row per key.
+
+    Diffs are replaced by 1 (presence marker); vals dropped.
+    """
+    b = delta_keyed
+    cols = [*(k for k in reversed(b.keys)), b.hashes]
+    order = jnp.lexsort(cols)
+    h = b.hashes[order]
+    ks = tuple(k[order] for k in b.keys)
+    live_in = b.live[order]
+    same = row_equal_prev((h, *ks))
+    # first live row of each (hash,key) run survives; a run may mix live and
+    # dead rows, so mark a row live if it's the first live one in its run
+    seg = jnp.cumsum((~same).astype(jnp.int32)) - 1
+    first_live = (
+        jax.ops.segment_min(
+            jnp.where(live_in, jnp.arange(h.shape[0]), h.shape[0]),
+            seg,
+            num_segments=h.shape[0],
+        )[seg]
+        == jnp.arange(h.shape[0])
+    ) & live_in
+    hashes = jnp.where(first_live, h, PAD_HASH)
+    keys = tuple(jnp.where(first_live, k, jnp.zeros_like(k)) for k in ks)
+    perm = jnp.argsort(~first_live, stable=True)
+    return UpdateBatch(
+        hashes[perm],
+        tuple(k[perm] for k in keys),
+        (),
+        jnp.where(first_live, 0, PAD_TIME)[perm].astype(jnp.uint64),
+        jnp.where(first_live, 1, 0)[perm].astype(jnp.int64),
+    )
+
+
+@jax.jit
+def _gather_total(probes: UpdateBatch, arr: UpdateBatch) -> jnp.ndarray:
+    lo = jnp.searchsorted(arr.hashes, probes.hashes, side="left")
+    hi = jnp.searchsorted(arr.hashes, probes.hashes, side="right")
+    return jnp.sum(jnp.where(probes.live, hi - lo, 0))
+
+
+@partial(jax.jit, static_argnames=("out_cap",))
+def _gather_materialize(probes: UpdateBatch, arr: UpdateBatch, out_cap: int) -> UpdateBatch:
+    """All arrangement rows whose key matches a probe key (collision-checked)."""
+    lo = jnp.searchsorted(arr.hashes, probes.hashes, side="left")
+    hi = jnp.searchsorted(arr.hashes, probes.hashes, side="right")
+    counts = jnp.where(probes.live, hi - lo, 0)
+    cum = jnp.cumsum(counts)
+    total = cum[-1]
+    j = jnp.arange(out_cap, dtype=cum.dtype)
+    pi = jnp.minimum(jnp.searchsorted(cum, j, side="right"), probes.cap - 1)
+    prev = jnp.where(pi > 0, cum[pi - 1], 0)
+    ai = jnp.clip(lo[pi] + (j - prev), 0, arr.cap - 1)
+    valid = j < total
+    eq = jnp.ones((out_cap,), dtype=jnp.bool_)
+    for pk, ak in zip(probes.keys, arr.keys):
+        eq = eq & (pk[pi] == ak[ai])
+    ok = valid & eq & (arr.diffs[ai] != 0)
+    return UpdateBatch(
+        hashes=jnp.where(ok, arr.hashes[ai], PAD_HASH),
+        keys=tuple(jnp.where(ok, k[ai], 0) for k in arr.keys),
+        vals=tuple(jnp.where(ok, v[ai], 0) for v in arr.vals),
+        times=jnp.where(ok, arr.times[ai], PAD_TIME),
+        diffs=jnp.where(ok, arr.diffs[ai], 0),
+    )
+
+
+def gather_groups(
+    probes: UpdateBatch, batches: list[UpdateBatch], as_of: int, val_dtypes=()
+) -> UpdateBatch:
+    """Current contents (as of `as_of`) of every probed group, consolidated."""
+    parts = []
+    for arr in batches:
+        total = int(_gather_total(probes, arr))
+        if total:
+            parts.append(_gather_materialize(probes, arr, bucket_cap(total)))
+    if not parts:
+        dtypes_k = tuple(k.dtype for k in probes.keys)
+        return UpdateBatch.empty(8, dtypes_k, val_dtypes)
+    acc = parts[0]
+    for p in parts[1:]:
+        acc = UpdateBatch.concat(acc, p)
+    return consolidate(advance_times(acc, as_of))
+
+
+@partial(jax.jit, static_argnames=("order_by", "limit", "offset"))
+def topk_select(rows: UpdateBatch, order_by, limit, offset: int, time) -> UpdateBatch:
+    """Window [offset, offset+limit) of each group's multiset, by order_by.
+
+    rows: consolidated group contents (keys = group cols). Multiplicities are
+    windowed with a segmented running sum — a row with diff 3 straddling the
+    boundary keeps the in-window portion of its diff.
+    """
+    n = rows.cap
+    d = jnp.maximum(rows.diffs, 0) * rows.live  # negative multiplicities ignored
+    sort_cols: list = []
+    # tie-break: remaining val columns ascending for determinism
+    used = [c for c, _ in order_by]
+    for i in reversed(range(len(rows.vals))):
+        if i not in used:
+            sort_cols.append(_ord_view(rows.vals[i], False))
+    for c, desc in reversed(order_by):
+        sort_cols.append(_ord_view(rows.vals[c], desc))
+    for k in reversed(rows.keys):
+        sort_cols.append(k)
+    sort_cols.append(rows.hashes)
+    order = jnp.lexsort(sort_cols)
+    b = rows.permute(order)
+    d = d[order]
+
+    run_start = ~row_equal_prev((b.hashes, *b.keys))
+    cum_incl = jnp.cumsum(d)
+    idx = jnp.arange(n)
+    first_idx = jax.lax.cummax(jnp.where(run_start, idx, -1))
+    cum_before = (cum_incl - d) - (cum_incl - d)[first_idx]
+
+    lim = (1 << 62) if limit is None else limit
+    hi_ = jnp.minimum(cum_before + d, offset + lim)
+    lo_ = jnp.maximum(cum_before, offset)
+    out_d = jnp.maximum(hi_ - lo_, 0).astype(jnp.int64)
+    ok = (out_d > 0) & b.live
+    t = jnp.asarray(time, dtype=jnp.uint64)
+    # raw output: the full row lives in vals; keys were only for grouping
+    return UpdateBatch(
+        hashes=jnp.where(ok, b.hashes, PAD_HASH),
+        keys=(),
+        vals=b.vals,
+        times=jnp.where(ok, t, PAD_TIME),
+        diffs=jnp.where(ok, out_d, 0),
+    )
+
+
+def _ord_view(col: jnp.ndarray, desc: bool) -> jnp.ndarray:
+    c = col.astype(jnp.int32) if col.dtype == jnp.bool_ else col
+    if not desc:
+        return c
+    if jnp.issubdtype(c.dtype, jnp.floating):
+        return -c
+    # Bitwise NOT reverses the total order for both signed (two's complement:
+    # ~x = -x-1, monotone decreasing, no INT_MIN overflow) and unsigned ints
+    # (negation would wrap 0 to 0 and keep it minimal).
+    return ~c
+
+
+@jax.jit
+def negate(b: UpdateBatch) -> UpdateBatch:
+    return UpdateBatch(b.hashes, b.keys, b.vals, b.times, -b.diffs)
+
+
+def topk_step(
+    arrangement,
+    delta_keyed: UpdateBatch,
+    plan: TopKPlan,
+    time: int,
+) -> UpdateBatch:
+    """One tick of TopK: emits new_topk − old_topk for affected groups.
+
+    `arrangement` is the input Arrangement keyed by plan.group_cols; the delta
+    must already be keyed the same way. This function inserts the delta.
+    """
+    probes = distinct_keys(delta_keyed)
+    vdt = tuple(v.dtype for v in delta_keyed.vals)
+    old_rows = gather_groups(probes, arrangement.batches, time, vdt)
+    arrangement.insert(delta_keyed, already_keyed=True)
+    new_rows = gather_groups(probes, arrangement.batches, time, vdt)
+    old_top = topk_select(old_rows, plan.order_by, plan.limit, plan.offset, time)
+    new_top = topk_select(new_rows, plan.order_by, plan.limit, plan.offset, time)
+    out = UpdateBatch.concat(new_top, negate(old_top))
+    return consolidate(out)

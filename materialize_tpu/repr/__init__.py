@@ -1,0 +1,21 @@
+from .batch import MIN_CAP, PAD_TIME, UpdateBatch, bucket_cap
+from .hashing import PAD_HASH, hash_columns, hash_columns_np, splitmix64
+from .timestamp import MAX_TS, Antichain
+from .types import ColType, ColumnDesc, RelationDesc, StringDictionary
+
+__all__ = [
+    "MIN_CAP",
+    "PAD_TIME",
+    "UpdateBatch",
+    "bucket_cap",
+    "PAD_HASH",
+    "hash_columns",
+    "hash_columns_np",
+    "splitmix64",
+    "MAX_TS",
+    "Antichain",
+    "ColType",
+    "ColumnDesc",
+    "RelationDesc",
+    "StringDictionary",
+]

@@ -1,0 +1,191 @@
+"""UpdateBatch — the universal device currency of the engine.
+
+A batch is a fixed-capacity structure-of-arrays of update triples
+``(key_cols, val_cols, time, diff)`` plus a precomputed u64 key hash, the TPU
+re-design of the reference's update-triple collections
+(doc/developer/change-data-capture.md:5-13) and of differential's `Batch`.
+
+**Padding discipline.** Capacities are static for XLA; unused rows are padding
+with ``hash == PAD_HASH`` (sorts last), ``diff == 0`` and ``time == PAD_TIME``.
+Because every IVM operator is linear in ``diff``, diff==0 rows annihilate:
+padding flows through joins/reduces/consolidation without masks. Capacities
+are bucketed to powers of two so XLA recompiles O(log n) times, not O(n).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .hashing import PAD_HASH, hash_columns
+
+PAD_TIME = np.uint64(0xFFFFFFFFFFFFFFFF)
+MIN_CAP = 8
+
+
+def bucket_cap(n: int, minimum: int = MIN_CAP) -> int:
+    """Round `n` up to the next power of two (at least `minimum`)."""
+    c = minimum
+    while c < n:
+        c <<= 1
+    return c
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class UpdateBatch:
+    hashes: jnp.ndarray  # u64 [cap] — hash of key columns (PAD_HASH = padding)
+    keys: tuple  # tuple of [cap] arrays (possibly empty tuple)
+    vals: tuple  # tuple of [cap] arrays
+    times: jnp.ndarray  # u64 [cap]
+    diffs: jnp.ndarray  # i64 [cap]
+
+    # -- pytree plumbing ---------------------------------------------------
+    def tree_flatten(self):
+        return (self.hashes, self.keys, self.vals, self.times, self.diffs), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def empty(cap: int, key_dtypes=(), val_dtypes=()) -> "UpdateBatch":
+        return UpdateBatch(
+            hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint64),
+            keys=tuple(jnp.zeros((cap,), dtype=dt) for dt in key_dtypes),
+            vals=tuple(jnp.zeros((cap,), dtype=dt) for dt in val_dtypes),
+            times=jnp.full((cap,), PAD_TIME, dtype=jnp.uint64),
+            diffs=jnp.zeros((cap,), dtype=jnp.int64),
+        )
+
+    @staticmethod
+    def build(key_cols, val_cols, times, diffs, cap: int | None = None) -> "UpdateBatch":
+        """Build a padded device batch from host (or device) columns."""
+        key_cols = tuple(jnp.asarray(c) for c in key_cols)
+        val_cols = tuple(jnp.asarray(c) for c in val_cols)
+        times = jnp.asarray(times, dtype=jnp.uint64)
+        diffs = jnp.asarray(diffs, dtype=jnp.int64)
+        n = int(times.shape[0])
+        if cap is None:
+            cap = bucket_cap(n)
+        if key_cols:
+            hashes = hash_columns(key_cols)
+        else:
+            hashes = jnp.zeros((n,), dtype=jnp.uint64)
+        b = UpdateBatch(hashes, key_cols, val_cols, times, diffs)
+        return b.with_capacity(cap)
+
+    # -- shape management --------------------------------------------------
+    @property
+    def cap(self) -> int:
+        return int(self.times.shape[0])
+
+    def with_capacity(self, cap: int) -> "UpdateBatch":
+        cur = self.cap
+        if cap == cur:
+            return self
+        if cap > cur:
+            pad = cap - cur
+
+            def ext(a, fill):
+                return jnp.concatenate([a, jnp.full((pad,), fill, dtype=a.dtype)])
+
+            return UpdateBatch(
+                ext(self.hashes, PAD_HASH),
+                tuple(ext(k, 0) for k in self.keys),
+                tuple(ext(v, 0) for v in self.vals),
+                ext(self.times, PAD_TIME),
+                ext(self.diffs, 0),
+            )
+        # Shrink: only sound if rows beyond `cap` are padding; callers check.
+        return UpdateBatch(
+            self.hashes[:cap],
+            tuple(k[:cap] for k in self.keys),
+            tuple(v[:cap] for v in self.vals),
+            self.times[:cap],
+            self.diffs[:cap],
+        )
+
+    def permute(self, perm: jnp.ndarray) -> "UpdateBatch":
+        return UpdateBatch(
+            self.hashes[perm],
+            tuple(k[perm] for k in self.keys),
+            tuple(v[perm] for v in self.vals),
+            self.times[perm],
+            self.diffs[perm],
+        )
+
+    @staticmethod
+    def concat(a: "UpdateBatch", b: "UpdateBatch") -> "UpdateBatch":
+        return UpdateBatch(
+            jnp.concatenate([a.hashes, b.hashes]),
+            tuple(jnp.concatenate([x, y]) for x, y in zip(a.keys, b.keys)),
+            tuple(jnp.concatenate([x, y]) for x, y in zip(a.vals, b.vals)),
+            jnp.concatenate([a.times, b.times]),
+            jnp.concatenate([a.diffs, b.diffs]),
+        )
+
+    # -- inspection --------------------------------------------------------
+    @property
+    def live(self) -> jnp.ndarray:
+        """Mask of rows that carry information (non-padding, non-zero diff)."""
+        return (self.hashes != PAD_HASH) & (self.diffs != 0)
+
+    def count(self) -> jnp.ndarray:
+        return jnp.sum(self.live.astype(jnp.int32))
+
+    def sort_cols(self) -> list:
+        """Columns for lexsort in canonical order: hash, keys…, vals…, time.
+
+        jnp.lexsort treats the LAST element as primary.
+        """
+        cols: list = [self.times]
+        cols.extend(_sortable(v) for v in reversed(self.vals))
+        cols.extend(_sortable(k) for k in reversed(self.keys))
+        cols.append(self.hashes)
+        return cols
+
+    def to_host(self) -> dict:
+        """Trimmed host copy: only live rows, in canonical order.
+
+        A row's data is its `vals` columns; `keys` are an arrangement artifact
+        (copies of key columns) and are not part of the row.
+        """
+        d = jax.device_get(
+            (self.hashes, self.vals, self.times, self.diffs, self.live)
+        )
+        hashes, vals, times, diffs, live = d
+        idx = np.nonzero(np.asarray(live))[0]
+        rows = {
+            "hashes": np.asarray(hashes)[idx],
+            "vals": tuple(np.asarray(v)[idx] for v in vals),
+            "times": np.asarray(times)[idx],
+            "diffs": np.asarray(diffs)[idx],
+        }
+        order = np.lexsort(
+            tuple(rows["vals"][::-1]) + (rows["times"], rows["hashes"])
+        )
+        return {
+            k: (tuple(c[order] for c in v) if isinstance(v, tuple) else v[order])
+            for k, v in rows.items()
+        }
+
+    def to_rows(self) -> list[tuple]:
+        """Host rows as (val-cols tuple, time, diff) triples, canonically sorted."""
+        h = self.to_host()
+        out = []
+        for i in range(len(h["times"])):
+            data = tuple(c[i].item() for c in h["vals"])
+            out.append((data, int(h["times"][i]), int(h["diffs"][i])))
+        return out
+
+
+def _sortable(col: jnp.ndarray) -> jnp.ndarray:
+    """A total-order sortable view of a column (bools widen, floats as-is)."""
+    if col.dtype == jnp.bool_:
+        return col.astype(jnp.int32)
+    return col

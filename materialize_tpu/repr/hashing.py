@@ -1,0 +1,66 @@
+"""Deterministic 64-bit row hashing on device.
+
+Every update batch carries a u64 hash of its key columns; arrangements sort by
+it, exchanges shard by it, joins probe by it. Collisions are handled (kernels
+re-check key equality on gather), so the hash only needs uniformity.
+Plays the role of the reference's key-hash exchange pacts
+(src/timely-util/src/pact.rs and differential's `Hashable`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# splitmix64 constants (public domain PRNG finalizer, Steele et al.)
+_C1 = np.uint64(0x9E3779B97F4A7C15)
+_C2 = np.uint64(0xBF58476D1CE4E5B9)
+_C3 = np.uint64(0x94D049BB133111EB)
+
+# Reserved sentinel: padding rows hash to PAD_HASH and sort to the end of
+# every batch. Real hashes are clamped below it.
+PAD_HASH = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def splitmix64(x: jnp.ndarray) -> jnp.ndarray:
+    x = x.astype(jnp.uint64)
+    x = x + _C1
+    x = (x ^ (x >> np.uint64(30))) * _C2
+    x = (x ^ (x >> np.uint64(27))) * _C3
+    return x ^ (x >> np.uint64(31))
+
+
+def _col_to_u64(col: jnp.ndarray) -> jnp.ndarray:
+    """Canonical u64 view of one column for hashing."""
+    if col.dtype == jnp.bool_:
+        return col.astype(jnp.uint64)
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        f = col.astype(jnp.float32)
+        f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # -0.0 == 0.0
+        return jax_bitcast_u32(f).astype(jnp.uint64)
+    return col.astype(jnp.uint64)
+
+
+def jax_bitcast_u32(f: jnp.ndarray) -> jnp.ndarray:
+    import jax.lax as lax
+
+    return lax.bitcast_convert_type(f, jnp.uint32)
+
+
+def hash_columns(cols: tuple[jnp.ndarray, ...]) -> jnp.ndarray:
+    """Combine key columns into one u64 hash per row, clamped below PAD_HASH."""
+    if not cols:
+        # Keyless (global) groups: constant hash 0 routes everything together.
+        raise ValueError("hash_columns needs at least one column; use zeros for keyless")
+    h = jnp.full(cols[0].shape, np.uint64(0x51ED270B_9B1F8C33), dtype=jnp.uint64)
+    for i, col in enumerate(cols):
+        salt = np.uint64(((i + 1) * int(_C1)) % (1 << 64))
+        h = splitmix64(h ^ splitmix64(_col_to_u64(col) + salt))
+    return jnp.where(h == PAD_HASH, PAD_HASH - np.uint64(1), h)
+
+
+def hash_columns_np(cols) -> np.ndarray:
+    """NumPy mirror of `hash_columns` (host-side oracle + batch construction)."""
+    import jax
+
+    return np.asarray(jax.device_get(hash_columns(tuple(jnp.asarray(c) for c in cols))))

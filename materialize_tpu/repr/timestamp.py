@@ -1,0 +1,66 @@
+"""Timestamps, diffs, and antichain frontiers (host-side control plane).
+
+The engine's logical time is a u64, totally ordered, matching the reference's
+`mz_repr::Timestamp` (ms-since-epoch u64, src/repr/src/timestamp.rs:46).
+Frontiers are antichains; for a total order an antichain is empty (= the
+collection is closed) or a single element. The class keeps the general
+multi-element shape so iterative scopes (product timestamps for WITH MUTUALLY
+RECURSIVE, reference render.rs:365) can reuse it.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+MAX_TS = (1 << 64) - 1
+
+
+@dataclass(frozen=True)
+class Antichain:
+    elements: tuple[int, ...]
+
+    @staticmethod
+    def from_elem(t: int) -> "Antichain":
+        return Antichain((int(t),))
+
+    @staticmethod
+    def empty() -> "Antichain":
+        return Antichain(())
+
+    @staticmethod
+    def minimum() -> "Antichain":
+        return Antichain((0,))
+
+    def is_empty(self) -> bool:
+        return not self.elements
+
+    def less_equal(self, t: int) -> bool:
+        """Some frontier element is <= t (i.e. time t is NOT yet complete)."""
+        return any(e <= t for e in self.elements)
+
+    def less_than(self, t: int) -> bool:
+        return any(e < t for e in self.elements)
+
+    def meet(self, other: "Antichain") -> "Antichain":
+        """Greatest lower bound (for total order: min of the fronts)."""
+        if self.is_empty():
+            return other
+        if other.is_empty():
+            return self
+        return Antichain((min(min(self.elements), min(other.elements)),))
+
+    def join(self, other: "Antichain") -> "Antichain":
+        """Least upper bound (for total order: max of the fronts)."""
+        if self.is_empty() or other.is_empty():
+            return Antichain.empty()
+        return Antichain((max(min(self.elements), min(other.elements)),))
+
+    def frontier(self) -> int:
+        """The single front element (total-order convenience); MAX_TS if empty."""
+        return min(self.elements) if self.elements else MAX_TS
+
+    def __le__(self, other: "Antichain") -> bool:
+        """self dominates-or-equals: every element of other is >= some element of self."""
+        return all(self.less_equal(t) or t == MAX_TS for t in other.elements) or (
+            other.is_empty()
+        )

@@ -1,0 +1,142 @@
+"""Column types, relation schemas, and the host↔device value codec.
+
+Plays the role of the reference's `mz-repr` crate (`src/repr/src/row.rs:120`,
+`src/repr/src/relation.rs`), re-designed for TPU: instead of a packed
+variable-width row byte encoding, relations are **fixed-width columnar device
+arrays** (structure-of-arrays). Variable-length data (strings) is
+dictionary-encoded host-side and travels as i64 codes; NUMERIC is fixed-point
+i64 (TPUs have no f64 ALU, and fixed-point gives byte-identical results).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class ColType(enum.Enum):
+    """Scalar column types. Each maps to a single device dtype.
+
+    Mirrors the subset of `SqlScalarType` the engine's device path supports
+    (reference: src/repr/src/relation_and_scalar.rs); remaining SQL ADTs
+    (jsonb, ranges, arrays) are host-side only for now.
+    """
+
+    INT64 = "int64"
+    INT32 = "int32"
+    FLOAT64 = "float64"  # device-side f32 on TPU; f64 on CPU test meshes
+    BOOL = "bool"
+    STRING = "string"  # dictionary code (i64)
+    TIMESTAMP = "timestamp"  # ms since epoch (i64), like mz Timestamp
+    NUMERIC = "numeric"  # fixed-point i64, scale in ColumnDesc
+
+    @property
+    def dtype(self) -> np.dtype:
+        return _DTYPES[self]
+
+
+_DTYPES = {
+    ColType.INT64: np.dtype(np.int64),
+    ColType.INT32: np.dtype(np.int32),
+    # float32 on device: TPU has no f64; SQL doubles round-trip through f32
+    # until a software-extended-precision kernel lands.
+    ColType.FLOAT64: np.dtype(np.float32),
+    ColType.BOOL: np.dtype(np.bool_),
+    ColType.STRING: np.dtype(np.int64),
+    ColType.TIMESTAMP: np.dtype(np.int64),
+    ColType.NUMERIC: np.dtype(np.int64),
+}
+
+
+@dataclass(frozen=True)
+class ColumnDesc:
+    name: str
+    typ: ColType
+    nullable: bool = False
+    scale: int = 2  # NUMERIC fixed-point decimal places
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.typ.dtype
+
+
+@dataclass(frozen=True)
+class RelationDesc:
+    """Named, typed columns plus an optional primary key (column indices).
+
+    Mirrors the reference's `RelationDesc` (src/repr/src/relation.rs).
+    """
+
+    columns: tuple[ColumnDesc, ...]
+    key: tuple[int, ...] = ()
+
+    @staticmethod
+    def of(*cols: tuple, key: tuple[int, ...] = ()) -> "RelationDesc":
+        descs = []
+        for c in cols:
+            if isinstance(c, ColumnDesc):
+                descs.append(c)
+            else:
+                name, typ = c[0], c[1]
+                descs.append(ColumnDesc(name, typ))
+        return RelationDesc(tuple(descs), key)
+
+    @property
+    def names(self) -> tuple[str, ...]:
+        return tuple(c.name for c in self.columns)
+
+    @property
+    def dtypes(self) -> tuple[np.dtype, ...]:
+        return tuple(c.dtype for c in self.columns)
+
+    def index_of(self, name: str) -> int:
+        return self.names.index(name)
+
+    @property
+    def arity(self) -> int:
+        return len(self.columns)
+
+
+class StringDictionary:
+    """Host-side interning of strings to dense i64 codes.
+
+    The device only ever sees codes; equality (GROUP BY / join keys) is exact.
+    Code order is insertion order, NOT collation order — ORDER BY on strings
+    decodes host-side. Precedent: the reference's row-spine per-column
+    dictionary compression (src/row-spine/src/lib.rs:9-28).
+    """
+
+    def __init__(self) -> None:
+        self._code: dict[str, int] = {}
+        self._strs: list[str] = []
+
+    def encode(self, s: str) -> int:
+        code = self._code.get(s)
+        if code is None:
+            code = len(self._strs)
+            self._code[s] = code
+            self._strs.append(s)
+        return code
+
+    def encode_many(self, xs) -> np.ndarray:
+        return np.array([self.encode(x) for x in xs], dtype=np.int64)
+
+    def decode(self, code: int) -> str:
+        return self._strs[int(code)]
+
+    def decode_many(self, codes) -> list[str]:
+        return [self._strs[int(c)] for c in codes]
+
+    def lookup(self, s: str) -> int | None:
+        """Code for `s` if already interned (for filter literals), else None."""
+        return self._code.get(s)
+
+    def __len__(self) -> int:
+        return len(self._strs)
+
+
+# A single shared dictionary per engine instance is attached to the catalog;
+# this module-level one serves tests and standalone kernel use.
+GLOBAL_DICT = StringDictionary()

@@ -1,0 +1,89 @@
+"""Consolidation kernel vs a pure-NumPy oracle (SURVEY.md §7.9 strategy)."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.ops import advance_times, consolidate
+from materialize_tpu.repr import UpdateBatch
+
+
+def oracle_consolidate(rows):
+    """rows: list of ((key..., val...), time, diff) -> consolidated dict."""
+    acc = {}
+    for data, t, d in rows:
+        k = (data, t)
+        acc[k] = acc.get(k, 0) + d
+    return {k: v for k, v in acc.items() if v != 0}
+
+
+def batch_rows_dict(b):
+    out = {}
+    for data, t, d in b.to_rows():
+        out[(data, t)] = out.get(data and (data, t) or (data, t), 0) + d
+    return out
+
+
+def test_consolidate_cancels_and_merges():
+    cols = (
+        np.array([1, 2, 1, 1], dtype=np.int64),
+        np.array([10, 20, 10, 10], dtype=np.int64),
+    )
+    times = [0, 0, 0, 1]
+    diffs = [1, 1, -1, 1]
+    b = consolidate(UpdateBatch.build((), cols, times, diffs))
+    rows = b.to_rows()
+    assert rows == [((1, 10), 1, 1), ((2, 20), 0, 1)] or sorted(rows) == sorted(
+        [((1, 10), 1, 1), ((2, 20), 0, 1)]
+    )
+    assert int(b.count()) == 2
+
+
+@pytest.mark.parametrize("n", [1, 7, 64, 500])
+def test_consolidate_random_vs_oracle(rng, n):
+    keys = (rng.integers(0, 20, n).astype(np.int64),)
+    vals = (
+        rng.integers(0, 5, n).astype(np.int64),
+        rng.integers(0, 3, n).astype(np.int64),
+    )
+    times = rng.integers(0, 4, n).astype(np.uint64)
+    diffs = rng.integers(-2, 3, n).astype(np.int64)
+    b = consolidate(UpdateBatch.build((), keys + vals, times, diffs))
+
+    rows = [
+        ((int(keys[0][i]), int(vals[0][i]), int(vals[1][i])), int(times[i]), int(diffs[i]))
+        for i in range(n)
+    ]
+    want = oracle_consolidate(rows)
+    got2 = {}
+    for data, t, d in b.to_rows():
+        got2[(data, t)] = got2.get((data, t), 0) + d
+    assert got2 == want
+
+
+def test_consolidate_idempotent(rng):
+    n = 100
+    cols = (rng.integers(0, 10, n).astype(np.int64),)
+    b = UpdateBatch.build(
+        (),
+        cols,
+        rng.integers(0, 3, n).astype(np.uint64),
+        rng.integers(-1, 2, n).astype(np.int64),
+    )
+    c1 = consolidate(b)
+    c2 = consolidate(c1)
+    assert c1.to_rows() == c2.to_rows()
+
+
+def test_advance_times_then_consolidate_compacts():
+    # +1 at t=0 and -1 at t=3 cancel once both are advanced to since=5.
+    b = UpdateBatch.build((), (np.array([7, 7], dtype=np.int64),), [0, 3], [1, -1])
+    adv = advance_times(b, 5)
+    c = consolidate(adv)
+    assert int(c.count()) == 0
+
+
+def test_consolidate_keyless():
+    b = UpdateBatch.build((), (np.array([1, 1, 2], dtype=np.int64),), [0, 0, 0], [1, 2, 1])
+    c = consolidate(b)
+    rows = c.to_rows()
+    assert sorted(rows) == [((1,), 0, 3), ((2,), 0, 1)]

@@ -1,0 +1,262 @@
+"""End-to-end dataflow runtime: render LIR plans, tick, peek.
+
+The headless-driver test style of the reference's clusterd-test-driver
+(SURVEY.md §4): hand-assembled plans, no SQL stack.
+"""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.dataflow import BuildDesc, Dataflow, DataflowDescription
+from materialize_tpu.dataflow import plan as lir
+from materialize_tpu.expr import CallBinary, Column, Literal, MapFilterProject
+from materialize_tpu.ops.reduce import AggregateExpr
+from materialize_tpu.ops.topk import TopKPlan
+from materialize_tpu.repr import UpdateBatch
+
+I64 = np.dtype(np.int64)
+
+
+def mkdelta(cols, tick, diffs=None):
+    n = len(cols[0])
+    return UpdateBatch.build(
+        (),
+        tuple(np.asarray(c, dtype=np.int64) for c in cols),
+        [tick] * n,
+        diffs if diffs is not None else [1] * n,
+    )
+
+
+def test_mfp_dataflow_peek():
+    desc = DataflowDescription(
+        source_imports={"src": (I64, I64)},
+        objects_to_build=[
+            BuildDesc(
+                "v",
+                lir.Mfp(
+                    lir.Get("src"),
+                    MapFilterProject(
+                        2,
+                        map_exprs=(CallBinary("mul", Column(1), Literal(2)),),
+                        predicates=(CallBinary("gt", Column(0), Literal(0)),),
+                        projection=(0, 2),
+                    ),
+                ),
+                (I64, I64),
+            )
+        ],
+        index_exports={"idx": ("v", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"src": mkdelta([[1, -1, 2], [10, 20, 30]], 0)})
+    assert df.peek("idx") == [(1, 20), (2, 60)]
+    # retraction flows through
+    df.step(1, {"src": mkdelta([[1], [10]], 1, [-1])})
+    assert df.peek("idx") == [(2, 60)]
+
+
+def test_sum_count_dataflow():
+    desc = DataflowDescription(
+        source_imports={"bids": (I64, I64, I64)},  # id, auction, amount
+        objects_to_build=[
+            BuildDesc(
+                "v",
+                lir.Reduce(
+                    lir.Get("bids"),
+                    key_cols=(1,),
+                    aggs=(
+                        AggregateExpr("sum", Column(2)),
+                        AggregateExpr("count", Literal(1)),
+                    ),
+                ),
+                (I64, I64, I64),
+            )
+        ],
+        index_exports={"idx": ("v", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"bids": mkdelta([[1, 2], [7, 7], [100, 50]], 0)})
+    assert df.peek("idx") == [(7, 150, 2)]
+    df.step(1, {"bids": mkdelta([[3], [8], [40]], 1)})
+    df.step(2, {"bids": mkdelta([[1], [7], [100]], 2, [-1])})
+    assert df.peek("idx") == [(7, 50, 1), (8, 40, 1)]
+
+
+def test_linear_join_dataflow():
+    # auctions(id, seller) join bids(id, auction_id, amount) on id=auction_id
+    desc = DataflowDescription(
+        source_imports={"auctions": (I64, I64), "bids": (I64, I64, I64)},
+        objects_to_build=[
+            BuildDesc(
+                "j",
+                lir.Join(
+                    inputs=(lir.Get("auctions"), lir.Get("bids")),
+                    plan=lir.LinearJoinPlan(
+                        stages=(lir.JoinStage(stream_key=(0,), lookup_key=(1,)),)
+                    ),
+                ),
+                (I64, I64, I64, I64, I64),
+            )
+        ],
+        index_exports={"idx": ("j", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"auctions": mkdelta([[1, 2], [90, 91]], 0)})
+    df.step(1, {"bids": mkdelta([[10, 11], [1, 1], [5, 6]], 1)})
+    assert df.peek("idx") == [(1, 90, 10, 1, 5), (1, 90, 11, 1, 6)]
+    # late-arriving auction joins older bids? bids keyed 3 arrives first
+    df.step(2, {"bids": mkdelta([[12], [3], [7]], 2)})
+    assert df.peek("idx") == [(1, 90, 10, 1, 5), (1, 90, 11, 1, 6)]
+    df.step(3, {"auctions": mkdelta([[3], [93]], 3)})
+    assert df.peek("idx") == [
+        (1, 90, 10, 1, 5),
+        (1, 90, 11, 1, 6),
+        (3, 93, 12, 3, 7),
+    ]
+
+
+def test_three_way_delta_join():
+    # r0(a,b) ⋈ r1(b,c) ⋈ r2(c,d): chain on b then c
+    # path for input k: stream through other arrangements
+    paths = (
+        # d r0: lookup r1 on b, then r2 on c (stream cols after stage1: a,b,b,c)
+        (
+            lir.DeltaPathStage(other_input=1, stream_key=(1,), lookup_key=(0,)),
+            lir.DeltaPathStage(other_input=2, stream_key=(3,), lookup_key=(0,)),
+        ),
+        # d r1: lookup r0 on b, then r2 on c (stream: b,c + a,b -> key c at 1)
+        (
+            lir.DeltaPathStage(other_input=0, stream_key=(0,), lookup_key=(1,)),
+            lir.DeltaPathStage(other_input=2, stream_key=(1,), lookup_key=(0,)),
+        ),
+        # d r2: lookup r1 on c, then r0 on b
+        (
+            lir.DeltaPathStage(other_input=1, stream_key=(0,), lookup_key=(1,)),
+            lir.DeltaPathStage(other_input=0, stream_key=(2,), lookup_key=(1,)),
+        ),
+    )
+    # canonical output order (a, b, b, c, c, d)
+    perms = (
+        (0, 1, 2, 3, 4, 5),  # r0 path: a,b | b,c | c,d
+        (2, 3, 0, 1, 4, 5),  # r1 path: b,c | a,b | c,d -> a,b,b,c,c,d
+        (4, 5, 2, 3, 0, 1),  # r2 path: c,d | b,c | a,b -> a,b,b,c,c,d
+    )
+    desc = DataflowDescription(
+        source_imports={"r0": (I64, I64), "r1": (I64, I64), "r2": (I64, I64)},
+        objects_to_build=[
+            BuildDesc(
+                "j",
+                lir.Join(
+                    inputs=(lir.Get("r0"), lir.Get("r1"), lir.Get("r2")),
+                    plan=lir.DeltaJoinPlan(paths=paths, permutations=perms),
+                ),
+                (I64,) * 6,
+            )
+        ],
+        index_exports={"idx": ("j", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"r0": mkdelta([[1], [5]], 0), "r1": mkdelta([[5], [8]], 0)})
+    assert df.peek("idx") == []
+    df.step(1, {"r2": mkdelta([[8], [99]], 1)})
+    assert df.peek("idx") == [(1, 5, 5, 8, 8, 99)]
+    # all three arrive in the same tick for a new chain
+    df.step(
+        2,
+        {
+            "r0": mkdelta([[2], [6]], 2),
+            "r1": mkdelta([[6], [9]], 2),
+            "r2": mkdelta([[9], [77]], 2),
+        },
+    )
+    assert df.peek("idx") == [(1, 5, 5, 8, 8, 99), (2, 6, 6, 9, 9, 77)]
+    # retraction of the middle relation removes the chain
+    df.step(3, {"r1": mkdelta([[5], [8]], 3, [-1])})
+    assert df.peek("idx") == [(2, 6, 6, 9, 9, 77)]
+
+
+def test_union_negate_except():
+    # EXCEPT ALL = A union negate(B), thresholded
+    desc = DataflowDescription(
+        source_imports={"a": (I64,), "b": (I64,)},
+        objects_to_build=[
+            BuildDesc(
+                "v",
+                lir.Threshold(
+                    lir.Union((lir.Get("a"), lir.Negate(lir.Get("b")))),
+                ),
+                (I64,),
+            )
+        ],
+        index_exports={"idx": ("v", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"a": mkdelta([[1, 1, 2, 3]], 0), "b": mkdelta([[1, 4]], 0)})
+    assert df.peek("idx") == [(1,), (2,), (3,)]
+
+
+def test_distinct():
+    desc = DataflowDescription(
+        source_imports={"a": (I64, I64)},
+        objects_to_build=[
+            BuildDesc("v", lir.Reduce(lir.Get("a"), key_cols=(0,), distinct=True), (I64,))
+        ],
+        index_exports={"idx": ("v", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"a": mkdelta([[1, 1, 2], [5, 6, 7]], 0)})
+    assert df.peek("idx") == [(1,), (2,)]
+    df.step(1, {"a": mkdelta([[1], [5]], 1, [-1])})
+    assert df.peek("idx") == [(1,), (2,)]  # still one (1,6) row
+    df.step(2, {"a": mkdelta([[1], [6]], 2, [-1])})
+    assert df.peek("idx") == [(2,)]
+
+
+def test_topk_dataflow():
+    desc = DataflowDescription(
+        source_imports={"bids": (I64, I64, I64)},
+        objects_to_build=[
+            BuildDesc(
+                "v",
+                lir.TopK(
+                    lir.Get("bids"),
+                    TopKPlan(group_cols=(1,), order_by=((2, True),), limit=1),
+                ),
+                (I64, I64, I64),
+            )
+        ],
+        index_exports={"idx": ("v", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"bids": mkdelta([[1, 2], [7, 7], [10, 30]], 0)})
+    assert df.peek("idx") == [(2, 7, 30)]
+    df.step(1, {"bids": mkdelta([[2], [7], [30]], 1, [-1])})
+    assert df.peek("idx") == [(1, 7, 10)]
+
+
+def test_error_stream_poisons_peek():
+    desc = DataflowDescription(
+        source_imports={"a": (I64, I64)},
+        objects_to_build=[
+            BuildDesc(
+                "v",
+                lir.Mfp(
+                    lir.Get("a"),
+                    MapFilterProject(
+                        2, map_exprs=(CallBinary("div", Column(0), Column(1)),), projection=(2,)
+                    ),
+                ),
+                (I64,),
+            )
+        ],
+        index_exports={"idx": ("v", (0,))},
+    )
+    df = Dataflow(desc)
+    df.step(0, {"a": mkdelta([[6], [3]], 0)})
+    assert df.peek("idx") == [(2,)]
+    df.step(1, {"a": mkdelta([[5], [0]], 1)})
+    with pytest.raises(RuntimeError, match="error"):
+        df.peek("idx")
+    # retracting the poisonous row heals the view
+    df.step(2, {"a": mkdelta([[5], [0]], 2, [-1])})
+    assert df.peek("idx") == [(2,)]

@@ -1,0 +1,134 @@
+"""Join kernel + arrangement spine vs NumPy oracles, including retractions."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.arrangement import Arrangement, arrange_batch
+from materialize_tpu.ops import consolidate
+from materialize_tpu.ops.join import join_against, join_materialize, join_total
+from materialize_tpu.repr import UpdateBatch, bucket_cap
+
+
+def mkbatch(cols, times, diffs):
+    return UpdateBatch.build(
+        (), tuple(np.asarray(c, dtype=np.int64) for c in cols), times, diffs
+    )
+
+
+def oracle_join(left_rows, right_rows, lkey, rkey):
+    """rows: (data, t, d); join on data[lkey]==data[rkey]; out left++right."""
+    out = {}
+    for ld, lt, dd in left_rows:
+        for rd, rt, rd_ in right_rows:
+            if tuple(ld[i] for i in lkey) == tuple(rd[i] for i in rkey):
+                k = (ld + rd, max(lt, rt))
+                out[k] = out.get(k, 0) + dd * rd_
+    return {k: v for k, v in out.items() if v != 0}
+
+
+def collect(batches):
+    acc = {}
+    for b in batches:
+        for data, t, d in b.to_rows():
+            acc[(data, t)] = acc.get((data, t), 0) + d
+    return {k: v for k, v in acc.items() if v != 0}
+
+
+def test_join_simple():
+    left = arrange_batch(mkbatch([[1, 2, 2], [10, 20, 21]], [0, 0, 0], [1, 1, 1]), (0,))
+    probe = arrange_batch(mkbatch([[2, 3], [200, 300]], [1, 1], [1, 1]), (0,))
+    total = int(join_total(probe, left))
+    assert total == 2  # key 2 matches two left rows
+    out = join_materialize(probe, left, bucket_cap(total), swap=True)
+    rows = collect([out])
+    assert rows == {((2, 20, 2, 200), 1): 1, ((2, 21, 2, 200), 1): 1}
+
+
+def test_join_retraction():
+    arr = arrange_batch(mkbatch([[5], [50]], [0], [2]), (0,))
+    probe = arrange_batch(mkbatch([[5], [500]], [3], [-1]), (0,))
+    out = join_against(probe, [arr])
+    rows = collect(out)
+    assert rows == {((5, 500, 5, 50), 3): -2}
+
+
+@pytest.mark.parametrize("n,m", [(20, 30), (100, 7)])
+def test_join_random_vs_oracle(rng, n, m):
+    lk = rng.integers(0, 10, n).astype(np.int64)
+    lv = rng.integers(0, 100, n).astype(np.int64)
+    lt = rng.integers(0, 3, n)
+    ld = rng.integers(-2, 3, n)
+    rk = rng.integers(0, 10, m).astype(np.int64)
+    rv = rng.integers(0, 100, m).astype(np.int64)
+    rt = rng.integers(0, 3, m)
+    rd = rng.integers(-2, 3, m)
+
+    left = arrange_batch(mkbatch([lk, lv], lt, ld), (0,))
+    right = arrange_batch(mkbatch([rk, rv], rt, rd), (0,))
+    out = join_against(left, [right])
+    got = collect(out)
+
+    lrows = [((int(lk[i]), int(lv[i])), int(lt[i]), int(ld[i])) for i in range(n)]
+    rrows = [((int(rk[i]), int(rv[i])), int(rt[i]), int(rd[i])) for i in range(m)]
+    want = oracle_join(lrows, rrows, (0,), (0,))
+    assert got == want
+
+
+def test_arrangement_spine_merging():
+    arr = Arrangement(key_cols=(0,))
+    total = {}
+    for tick in range(10):
+        k = np.arange(tick * 4, tick * 4 + 4, dtype=np.int64) % 13
+        v = np.full(4, tick, dtype=np.int64)
+        arr.insert(mkbatch([k, v], [tick] * 4, [1] * 4))
+        for i in range(4):
+            key = (int(k[i]), tick)
+            total[key] = total.get(key, 0) + 1
+    assert arr.count() == 40
+    assert len(arr.batches) <= 5  # geometric merging kept the spine short
+    merged = arr.merged()
+    rows = merged.to_rows()
+    assert len(rows) == 40
+
+
+def test_arrangement_compaction_cancels():
+    arr = Arrangement(key_cols=(0,))
+    arr.insert(mkbatch([[1], [10]], [0], [1]))
+    arr.insert(mkbatch([[1], [10]], [5], [-1]))
+    arr.compact(10)
+    m = arr.merged()
+    assert int(m.count()) == 0
+
+
+def test_incremental_join_three_term_formula(rng):
+    """dOut = dA⋈B + A⋈dB + dA⋈dB over several ticks equals full recompute."""
+    A_arr = Arrangement(key_cols=(0,))
+    B_arr = Arrangement(key_cols=(0,))
+    all_a, all_b, got = [], [], {}
+    for tick in range(5):
+        na, nb = 6, 4
+        ak = rng.integers(0, 5, na).astype(np.int64)
+        av = rng.integers(0, 50, na).astype(np.int64)
+        ad = rng.integers(-1, 2, na)
+        bk = rng.integers(0, 5, nb).astype(np.int64)
+        bv = rng.integers(0, 50, nb).astype(np.int64)
+        bd = rng.integers(-1, 2, nb)
+        dA = arrange_batch(mkbatch([ak, av], [tick] * na, ad), (0,))
+        dB = arrange_batch(mkbatch([bk, bv], [tick] * nb, bd), (0,))
+
+        outs = []
+        outs += join_against(dA, B_arr.batches)  # dA ⋈ B_old
+        outs += join_against(dB, A_arr.batches, swap=True)  # A_old ⋈ dB
+        outs += join_against(dA, [dB])  # dA ⋈ dB
+        for b in outs:
+            for data, t, d in b.to_rows():
+                got[(data, t)] = got.get((data, t), 0) + d
+
+        A_arr.insert(dA, already_keyed=True)
+        B_arr.insert(dB, already_keyed=True)
+        all_a += [((int(ak[i]), int(av[i])), tick, int(ad[i])) for i in range(na)]
+        all_b += [((int(bk[i]), int(bv[i])), tick, int(bd[i])) for i in range(nb)]
+
+    got = {k: v for k, v in got.items() if v != 0}
+    want = oracle_join(all_a, all_b, (0,), (0,))
+    assert got == want

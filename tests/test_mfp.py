@@ -1,0 +1,96 @@
+"""MapFilterProject + scalar eval: maps, filters, projection, error streams."""
+
+import numpy as np
+
+from materialize_tpu.expr import (
+    CallBinary,
+    Column,
+    EvalErr,
+    Literal,
+    MapFilterProject,
+)
+from materialize_tpu.repr import UpdateBatch
+
+
+def mkbatch(*cols, diffs=None, times=None):
+    n = len(cols[0])
+    return UpdateBatch.build(
+        (),
+        tuple(np.asarray(c, dtype=np.int64) for c in cols),
+        np.asarray(times if times is not None else [0] * n),
+        np.asarray(diffs if diffs is not None else [1] * n),
+    )
+
+
+def test_identity():
+    b = mkbatch([1, 2, 3])
+    mfp = MapFilterProject.identity(1)
+    oks, errs = mfp.apply(b)
+    assert [r[0] for r in oks.to_rows()] == [(1,), (2,), (3,)]
+    assert int(errs.count()) == 0
+
+
+def test_map_and_project():
+    b = mkbatch([1, 2], [10, 20])
+    # out = (col1 + col0, col0)
+    mfp = MapFilterProject(
+        input_arity=2,
+        map_exprs=(CallBinary("add", Column(0), Column(1)),),
+        projection=(2, 0),
+    )
+    oks, _ = mfp.apply(b)
+    assert sorted(r[0] for r in oks.to_rows()) == [(11, 1), (22, 2)]
+
+
+def test_filter():
+    b = mkbatch([1, 2, 3, 4])
+    mfp = MapFilterProject(
+        input_arity=1,
+        predicates=(CallBinary("gt", Column(0), Literal(2)),),
+    )
+    oks, _ = mfp.apply(b)
+    assert sorted(r[0] for r in oks.to_rows()) == [(3,), (4,)]
+
+
+def test_filter_preserves_diffs_and_times():
+    b = mkbatch([1, 5], diffs=[-3, 2], times=[7, 9])
+    mfp = MapFilterProject(
+        input_arity=1, predicates=(CallBinary("gt", Column(0), Literal(0)),)
+    )
+    oks, _ = mfp.apply(b)
+    assert sorted(oks.to_rows()) == [((1,), 7, -3), ((5,), 9, 2)]
+
+
+def test_division_by_zero_goes_to_err_stream():
+    b = mkbatch([10, 10], [2, 0], diffs=[1, 4])
+    mfp = MapFilterProject(
+        input_arity=2,
+        map_exprs=(CallBinary("div", Column(0), Column(1)),),
+        projection=(2,),
+    )
+    oks, errs = mfp.apply(b)
+    assert [r[0] for r in oks.to_rows()] == [(5,)]
+    err_rows = errs.to_rows()
+    assert err_rows == [((int(EvalErr.DIVISION_BY_ZERO),), 0, 4)]
+
+
+def test_integer_division_truncates_toward_zero():
+    b = mkbatch([-7, 7, -7], [2, 2, -2])
+    mfp = MapFilterProject(
+        input_arity=2,
+        map_exprs=(CallBinary("div", Column(0), Column(1)),),
+        projection=(2,),
+    )
+    oks, _ = mfp.apply(b)
+    # -7/2 -> -3 (trunc), 7/2 -> 3, -7/-2 -> 3 (trunc toward zero)
+    assert sorted(r[0][0] for r in oks.to_rows()) == [-3, 3, 3]
+
+
+def test_demanded_columns():
+    mfp = MapFilterProject(
+        input_arity=4,
+        map_exprs=(CallBinary("add", Column(0), Column(2)),),
+        predicates=(CallBinary("gt", Column(4), Literal(0)),),
+        projection=(4,),
+    )
+    assert mfp.demanded_columns() == {0, 2}

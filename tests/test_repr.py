@@ -1,0 +1,86 @@
+"""repr layer: hashing determinism, batch build/pad/roundtrip, antichains."""
+
+import numpy as np
+
+from materialize_tpu.repr import (
+    Antichain,
+    ColType,
+    PAD_HASH,
+    RelationDesc,
+    StringDictionary,
+    UpdateBatch,
+    bucket_cap,
+    hash_columns_np,
+)
+
+
+def test_hash_deterministic_and_uniformish():
+    a = np.arange(1000, dtype=np.int64)
+    h1 = hash_columns_np((a,))
+    h2 = hash_columns_np((a,))
+    np.testing.assert_array_equal(h1, h2)
+    assert len(np.unique(h1)) == 1000
+    assert (h1 != PAD_HASH).all()
+    # multi-column hash differs from single-column
+    h3 = hash_columns_np((a, a))
+    assert (h1 != h3).any()
+
+
+def test_hash_order_sensitive():
+    a = np.array([1, 2], dtype=np.int64)
+    b = np.array([2, 1], dtype=np.int64)
+    assert (hash_columns_np((a, b)) != hash_columns_np((b, a))).all()
+
+
+def test_bucket_cap():
+    assert bucket_cap(0) == 8
+    assert bucket_cap(8) == 8
+    assert bucket_cap(9) == 16
+    assert bucket_cap(1000) == 1024
+
+
+def test_batch_build_roundtrip():
+    cols = (
+        np.array([3, 1, 2], dtype=np.int64),
+        np.array([30, 10, 20], dtype=np.int64),
+    )
+    b = UpdateBatch.build((), cols, np.array([5, 5, 5]), np.array([1, 1, -1]))
+    assert b.cap == 8  # bucketed
+    assert int(b.count()) == 3
+    rows = b.to_rows()
+    assert ((1, 10), 5, 1) in rows
+    assert ((2, 20), 5, -1) in rows
+    assert len(rows) == 3
+
+
+def test_batch_capacity_growth():
+    b = UpdateBatch.build((), (np.arange(3, dtype=np.int64),), [0, 0, 0], [1, 1, 1])
+    big = b.with_capacity(32)
+    assert big.cap == 32
+    assert int(big.count()) == 3
+
+
+def test_relation_desc():
+    d = RelationDesc.of(("id", ColType.INT64), ("name", ColType.STRING), key=(0,))
+    assert d.arity == 2
+    assert d.index_of("name") == 1
+    assert d.dtypes[0] == np.dtype(np.int64)
+
+
+def test_string_dictionary():
+    sd = StringDictionary()
+    codes = sd.encode_many(["a", "b", "a"])
+    np.testing.assert_array_equal(codes, [0, 1, 0])
+    assert sd.decode_many(codes) == ["a", "b", "a"]
+    assert sd.lookup("zzz") is None
+
+
+def test_antichain_total_order():
+    f = Antichain.from_elem(5)
+    assert f.less_equal(5) and f.less_equal(9)
+    assert not f.less_equal(4)
+    assert not f.less_than(5)
+    assert Antichain.empty().is_empty()
+    assert f.meet(Antichain.from_elem(3)).frontier() == 3
+    assert f.join(Antichain.from_elem(3)).frontier() == 5
+    assert f.join(Antichain.empty()).is_empty()

@@ -1,0 +1,54 @@
+"""Threshold / Distinct kernels vs oracle."""
+
+import numpy as np
+
+from materialize_tpu.ops.reduce import AccumState
+from materialize_tpu.ops.threshold import threshold_step
+from materialize_tpu.repr import UpdateBatch
+
+
+def mkbatch(cols, times, diffs):
+    return UpdateBatch.build(
+        (), tuple(np.asarray(c, dtype=np.int64) for c in cols), times, diffs
+    )
+
+
+def run(mode, ticks):
+    state = AccumState.empty(8, (np.dtype(np.int64),), ())
+    integrated = {}
+    counts = {}
+    for t, (col, diffs) in enumerate(ticks):
+        state, out = threshold_step(state, mkbatch([col], [t] * len(diffs), diffs), mode, t)
+        for data, _tt, d in out.to_rows():
+            integrated[data] = integrated.get(data, 0) + d
+        for v, d in zip(col, diffs):
+            counts[(int(v),)] = counts.get((int(v),), 0) + d
+    integrated = {k: v for k, v in integrated.items() if v != 0}
+    if mode == "distinct":
+        want = {k: 1 for k, c in counts.items() if c > 0}
+    else:
+        want = {k: max(c, 0) for k, c in counts.items() if max(c, 0) != 0}
+    assert integrated == want, f"{integrated} != {want}"
+
+
+def test_distinct():
+    run("distinct", [([1, 1, 2], [1, 1, 1]), ([1], [-1]), ([1], [-1])])
+    # key 1: count 2 -> 1 -> 0 (disappears), key 2 stays
+
+
+def test_threshold_clamps_negative():
+    run("threshold", [([5], [-3]), ([5], [2])])  # net -1 -> clamped out
+
+
+def test_threshold_counts():
+    run("threshold", [([1, 2], [2, 1]), ([1], [1]), ([2], [-1])])
+
+
+def test_distinct_random(rng):
+    ticks = []
+    for _ in range(5):
+        n = int(rng.integers(1, 20))
+        col = rng.integers(0, 8, n).astype(np.int64)
+        diffs = rng.integers(-1, 2, n).tolist()
+        ticks.append((col, diffs))
+    run("distinct", ticks)

@@ -1,0 +1,121 @@
+"""TopK kernel vs NumPy oracle: windowing, retractions, min/max via k=1."""
+
+import numpy as np
+
+from materialize_tpu.arrangement import Arrangement, arrange_batch
+from materialize_tpu.ops.topk import TopKPlan, topk_step
+from materialize_tpu.repr import UpdateBatch
+
+
+def mkbatch(cols, times, diffs):
+    return UpdateBatch.build(
+        (), tuple(np.asarray(c, dtype=np.int64) for c in cols), times, diffs
+    )
+
+
+def oracle_topk(rows, group_cols, order_by, limit, offset=0):
+    """rows: dict data->count. Returns dict data->count of the windowed multiset."""
+    groups = {}
+    for data, cnt in rows.items():
+        if cnt <= 0:
+            continue
+        g = tuple(data[i] for i in group_cols)
+        groups.setdefault(g, []).extend([data] * cnt)
+    out = {}
+    for g, members in groups.items():
+        def sk(data):
+            return tuple(
+                (-data[c] if desc else data[c]) for c, desc in order_by
+            ) + data
+        members.sort(key=sk)
+        lim = len(members) if limit is None else limit
+        for data in members[offset : offset + lim]:
+            out[data] = out.get(data, 0) + 1
+    return out
+
+
+def run_scenario(ticks, plan):
+    """ticks: list of (cols..., diffs). Integrate topk_step outputs and compare."""
+    arr = Arrangement(key_cols=plan.group_cols)
+    integrated = {}
+    current = {}
+    for t, (cols, diffs) in enumerate(ticks):
+        delta = arrange_batch(mkbatch(cols, [t] * len(diffs), diffs), plan.group_cols)
+        out = topk_step(arr, delta, plan, t)
+        for data, _tt, d in out.to_rows():
+            integrated[data] = integrated.get(data, 0) + d
+        for i in range(len(diffs)):
+            data = tuple(int(c[i]) for c in np.asarray(cols))
+            current[data] = current.get(data, 0) + diffs[i]
+    integrated = {k: v for k, v in integrated.items() if v != 0}
+    want = oracle_topk(current, plan.group_cols, plan.order_by, plan.limit, plan.offset)
+    assert integrated == want, f"{integrated} != {want}"
+
+
+def test_top2_per_group_basic():
+    plan = TopKPlan(group_cols=(0,), order_by=((1, True),), limit=2)
+    # group 1: vals 10,20,30 -> top2 {30,20}; group 2: 5 -> {5}
+    run_scenario(
+        [([np.array([1, 1, 1, 2]), np.array([10, 20, 30, 5])], [1, 1, 1, 1])], plan
+    )
+
+
+def test_topk_incremental_overtake():
+    plan = TopKPlan(group_cols=(0,), order_by=((1, True),), limit=1)
+    ticks = [
+        ([np.array([1]), np.array([10])], [1]),
+        ([np.array([1]), np.array([50])], [1]),  # new max
+        ([np.array([1]), np.array([50])], [-1]),  # retract max -> back to 10
+    ]
+    run_scenario(ticks, plan)
+
+
+def test_topk_multiplicity_window():
+    # one row with diff 3 and limit 2: only 2 copies survive
+    plan = TopKPlan(group_cols=(0,), order_by=((1, False),), limit=2)
+    run_scenario([([np.array([1]), np.array([7])], [3])], plan)
+
+
+def test_topk_offset():
+    plan = TopKPlan(group_cols=(0,), order_by=((1, False),), limit=2, offset=1)
+    run_scenario(
+        [([np.array([1, 1, 1, 1]), np.array([4, 3, 2, 1])], [1, 1, 1, 1])], plan
+    )
+
+
+def test_min_via_top1():
+    plan = TopKPlan(group_cols=(0,), order_by=((1, False),), limit=1)
+    ticks = [
+        ([np.array([1, 1, 2]), np.array([5, 3, 9])], [1, 1, 1]),
+        ([np.array([1]), np.array([3])], [-1]),  # retract the min
+    ]
+    run_scenario(ticks, plan)
+
+
+def test_desc_order_int64_min_and_zero():
+    """Descending order must survive INT64_MIN (negation overflow trap)."""
+    plan = TopKPlan(group_cols=(0,), order_by=((1, True),), limit=1)
+    lo = np.iinfo(np.int64).min
+    run_scenario([([np.array([1, 1]), np.array([lo, 5])], [1, 1])], plan)
+
+
+def test_topk_random(rng):
+    plan = TopKPlan(group_cols=(0,), order_by=((1, True), (2, False)), limit=3)
+    ticks = []
+    live = {}
+    for t in range(6):
+        n = int(rng.integers(1, 15))
+        g = rng.integers(0, 4, n).astype(np.int64)
+        a = rng.integers(0, 10, n).astype(np.int64)
+        b = rng.integers(0, 10, n).astype(np.int64)
+        ds = []
+        for i in range(n):
+            data = (int(g[i]), int(a[i]), int(b[i]))
+            if live.get(data, 0) > 0 and rng.random() < 0.3:
+                ds.append(-1)
+                live[data] -= 1
+            else:
+                ds.append(1)
+                live[data] = live.get(data, 0) + 1
+        ticks.append(([g, a, b], ds))
+    run_scenario(ticks, plan)
