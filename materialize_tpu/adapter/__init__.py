@@ -1,0 +1,5 @@
+from .catalog import Catalog, CatalogItem
+from .coordinator import Coordinator, ExecResult
+from .timestamp_oracle import TimestampOracle
+
+__all__ = ["Catalog", "CatalogItem", "Coordinator", "ExecResult", "TimestampOracle"]

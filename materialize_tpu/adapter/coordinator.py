@@ -1,0 +1,483 @@
+"""Coordinator: the single-threaded command loop executing SQL.
+
+The analogue of the reference's `Coordinator` (src/adapter/src/coord.rs:1989)
+and its sequencer: DDL transacts against the catalog, INSERTs group-commit at
+oracle write timestamps (coord/appends.rs), SELECTs choose between the index
+fast path and an ephemeral one-shot dataflow (sequencer/inner/peek.rs:119),
+materialized views install continuously-maintained dataflows whose outputs
+feed storage collections (the persist-sink shape, sink/materialized_view.rs).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..arrangement.spine import Arrangement
+from ..dataflow import Dataflow
+from ..dataflow import plan as lir
+from ..expr import relation as mir
+from ..ops.consolidate import advance_times, consolidate
+from ..repr.batch import UpdateBatch
+from ..repr.types import ColType, ColumnDesc, RelationDesc
+from ..sql import ast
+from ..sql.lower import Lowerer, lower_to_dataflow
+from ..sql.parser import parse_statement, parse_statements
+from ..sql.plan import PlanError, Planner, PlannedQuery, PType
+from ..storage.generator import AuctionGenerator, TpchGenerator
+from ..transform import optimize
+from .catalog import Catalog, CatalogItem, coltype_of
+from .timestamp_oracle import TimestampOracle
+
+
+@dataclass
+class ExecResult:
+    kind: str  # rows | status
+    rows: list = field(default_factory=list)
+    columns: tuple = ()
+    status: str = "ok"
+
+
+class StorageCollection:
+    """Host-side durable collection of update batches (persist-lite).
+
+    Mirrors a persist shard's role: the definite record of a table/source/
+    materialized view, readable as a snapshot at any time ≤ upper.
+    """
+
+    def __init__(self, dtypes: tuple):
+        self.dtypes = tuple(dtypes)
+        self.arr = Arrangement(key_cols=())
+        self.upper = 0
+
+    def append(self, batch: UpdateBatch, tick: int) -> None:
+        self.arr.insert(batch)
+        self.upper = max(self.upper, tick + 1)
+
+    def snapshot(self, as_of: int) -> UpdateBatch:
+        """Consolidated contents as of `as_of` (times advanced to as_of)."""
+        if not self.arr.batches:
+            return UpdateBatch.empty(8, (), self.dtypes)
+        merged = self.arr.merged()
+        return consolidate(advance_times(merged, as_of))
+
+
+class Coordinator:
+    def __init__(self) -> None:
+        self.catalog = Catalog()
+        self.oracle = TimestampOracle()
+        self.storage: dict[str, StorageCollection] = {}
+        self.generators: list = []  # (generator, {table -> gid})
+        # installed continuous dataflows in dependency order: (mv_gid, Dataflow, src_gids)
+        self.dataflows: list = []
+        self.planner = Planner(self.catalog)
+
+    # -- public API ----------------------------------------------------------
+    def execute(self, sql: str) -> ExecResult:
+        stmt = parse_statement(sql)
+        return self.execute_stmt(stmt)
+
+    def execute_script(self, sql: str) -> list[ExecResult]:
+        return [self.execute_stmt(s) for s in parse_statements(sql)]
+
+    def execute_stmt(self, stmt) -> ExecResult:
+        if isinstance(stmt, ast.CreateTable):
+            return self._create_table(stmt)
+        if isinstance(stmt, ast.CreateSource):
+            return self._create_source(stmt)
+        if isinstance(stmt, ast.CreateView):
+            return self._create_view(stmt)
+        if isinstance(stmt, ast.CreateMaterializedView):
+            return self._create_materialized_view(stmt)
+        if isinstance(stmt, ast.CreateIndex):
+            return self._create_index(stmt)
+        if isinstance(stmt, ast.Insert):
+            return self._insert(stmt)
+        if isinstance(stmt, ast.Delete):
+            return self._delete(stmt)
+        if isinstance(stmt, ast.SelectStatement):
+            return self._select(stmt.query)
+        if isinstance(stmt, ast.Explain):
+            return self._explain(stmt)
+        if isinstance(stmt, ast.Show):
+            return self._show(stmt)
+        if isinstance(stmt, ast.DropObject):
+            return self._drop(stmt)
+        raise PlanError(f"unsupported statement: {type(stmt).__name__}")
+
+    # -- DDL -------------------------------------------------------------------
+    def _create_table(self, stmt: ast.CreateTable) -> ExecResult:
+        cols = tuple(
+            ColumnDesc(c.name, coltype_of(c.typ), nullable=not c.not_null)
+            for c in stmt.columns
+        )
+        desc = RelationDesc(cols)
+        item = self.catalog.create(CatalogItem(stmt.name, "table", desc=desc))
+        self.storage[item.global_id] = StorageCollection(desc.dtypes)
+        return ExecResult("status", status=f"CREATE TABLE")
+
+    _AUCTION_TABLES = {
+        "organizations": RelationDesc.of(
+            ("id", ColType.INT64), ("name", ColType.STRING), key=(0,)
+        ),
+        "users": RelationDesc.of(
+            ("id", ColType.INT64), ("org_id", ColType.INT64), ("name", ColType.STRING),
+            key=(0,),
+        ),
+        "accounts": RelationDesc.of(
+            ("id", ColType.INT64), ("org_id", ColType.INT64), ("balance", ColType.INT64),
+            key=(0,),
+        ),
+        "auctions": RelationDesc.of(
+            ("id", ColType.INT64), ("seller", ColType.INT64), ("item", ColType.STRING),
+            ("end_time", ColType.TIMESTAMP), key=(0,),
+        ),
+        "bids": RelationDesc.of(
+            ("id", ColType.INT64), ("buyer", ColType.INT64), ("auction_id", ColType.INT64),
+            ("amount", ColType.INT64), ("bid_time", ColType.TIMESTAMP), key=(0,),
+        ),
+    }
+
+    _TPCH_TABLES = {
+        "customer": RelationDesc.of(
+            ("c_custkey", ColType.INT64), ("c_mktsegment", ColType.INT64),
+            ("c_nationkey", ColType.INT64), key=(0,),
+        ),
+        "orders": RelationDesc.of(
+            ("o_orderkey", ColType.INT64), ("o_custkey", ColType.INT64),
+            ("o_orderdate", ColType.TIMESTAMP), ("o_shippriority", ColType.INT64),
+            key=(0,),
+        ),
+        "lineitem": RelationDesc.of(
+            ("l_orderkey", ColType.INT64),
+            ColumnDesc("l_extendedprice", ColType.NUMERIC, scale=2),
+            ColumnDesc("l_discount", ColType.NUMERIC, scale=2),
+            ("l_shipdate", ColType.TIMESTAMP), ("l_quantity", ColType.INT64),
+            ("l_partkey", ColType.INT64),
+        ),
+        "part": RelationDesc.of(
+            ("p_partkey", ColType.INT64), ("p_brand", ColType.INT64),
+            ("p_container", ColType.INT64), key=(0,),
+        ),
+    }
+
+    def _create_source(self, stmt: ast.CreateSource) -> ExecResult:
+        opts = dict(stmt.options)
+        if stmt.generator == "auction":
+            gen = AuctionGenerator(seed=0, dict_=self.catalog.dict)
+            tables = self._AUCTION_TABLES
+        elif stmt.generator == "tpch":
+            sf = float(opts.get("scale factor", 0.01) or 0.01)
+            gen = TpchGenerator(sf=sf)
+            tables = self._TPCH_TABLES
+        else:
+            raise PlanError(f"unsupported load generator {stmt.generator}")
+        gids = {}
+        for tname, desc in tables.items():
+            item = self.catalog.create(CatalogItem(tname, "source", desc=desc))
+            self.storage[item.global_id] = StorageCollection(desc.dtypes)
+            gids[tname] = item.global_id
+        self.catalog.create(CatalogItem(stmt.name, "source_parent", generator=stmt.generator))
+        self.generators.append((gen, gids))
+        if stmt.generator == "auction":
+            ts = self.oracle.write_ts()
+            for tname, cols in gen.static_tables().items():
+                n = len(cols[0])
+                batch = UpdateBatch.build((), cols, np.full(n, ts), np.ones(n, dtype=np.int64))
+                self._apply_writes({gids[tname]: batch}, ts)
+        elif stmt.generator == "tpch":
+            ts = self.oracle.write_ts()
+            init = gen.initial_batches(ts)
+            self._apply_writes({gids[t]: b for t, b in init.items()}, ts)
+        return ExecResult("status", status="CREATE SOURCE")
+
+    def _create_view(self, stmt: ast.CreateView) -> ExecResult:
+        pq = self.planner.plan_query(stmt.query)
+        self.catalog.create(
+            CatalogItem(stmt.name, "view", desc=pq.desc, query_ast=stmt.query, mir=pq)
+        )
+        return ExecResult("status", status="CREATE VIEW")
+
+    def _create_materialized_view(self, stmt: ast.CreateMaterializedView) -> ExecResult:
+        pq = self.planner.plan_query(stmt.query)
+        rel = pq.mir
+        if pq.finishing.limit is not None:
+            from ..sql.plan import _apply_finishing_as_topk
+
+            rel = _apply_finishing_as_topk(pq)
+        rel = optimize(rel)
+        item = self.catalog.create(
+            CatalogItem(stmt.name, "materialized_view", desc=pq.desc, query_ast=stmt.query)
+        )
+        gid = item.global_id
+        src_gids = sorted(_collect_gets(rel))
+        env = {g: self.storage[g].dtypes for g in src_gids}
+        desc = lower_to_dataflow(gid, rel, env, src_gids, index_key=(), as_of=0)
+        df = Dataflow(desc)
+        # hydrate: snapshot all inputs at the current read timestamp
+        as_of = self.oracle.read_ts()
+        snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
+        results = df.step(as_of, snaps)
+        self.storage[gid] = StorageCollection(pq.desc.dtypes)
+        out = results.get(gid)
+        if out is not None and out[0] is not None:
+            self.storage[gid].append(out[0], as_of)
+        self.dataflows.append((gid, df, src_gids))
+        item.mir = rel
+        return ExecResult("status", status="CREATE MATERIALIZED VIEW")
+
+    def _create_index(self, stmt: ast.CreateIndex) -> ExecResult:
+        on = self.catalog.get(stmt.on)
+        key = tuple(on.desc.index_of(c) for c in stmt.key_columns) if stmt.key_columns else tuple(on.desc.key)
+        name = stmt.name or f"{stmt.on}_idx"
+        self.catalog.create(
+            CatalogItem(name, "index", index_on=stmt.on, index_key=key)
+        )
+        return ExecResult("status", status="CREATE INDEX")
+
+    def _drop(self, stmt: ast.DropObject) -> ExecResult:
+        item = self.catalog.drop(stmt.name, stmt.if_exists)
+        if item is not None:
+            self.storage.pop(item.global_id, None)
+            self.dataflows = [d for d in self.dataflows if d[0] != item.global_id]
+        return ExecResult("status", status=f"DROP {stmt.kind.upper()}")
+
+    # -- DML -------------------------------------------------------------------
+    def _insert(self, stmt: ast.Insert) -> ExecResult:
+        item = self.catalog.get(stmt.table)
+        if item.kind != "table":
+            raise PlanError(f"cannot INSERT into {item.kind} {stmt.table}")
+        desc = item.desc
+        if stmt.columns:
+            positions = [desc.index_of(c) for c in stmt.columns]
+        else:
+            positions = list(range(desc.arity))
+        cols = [[] for _ in range(desc.arity)]
+        for row in stmt.rows:
+            if len(row) != len(positions):
+                raise PlanError("INSERT row arity mismatch")
+            vals = [None] * desc.arity
+            for pos, e in zip(positions, row):
+                vals[pos] = self._literal_value(e, desc.columns[pos])
+            for i, v in enumerate(vals):
+                if v is None:
+                    raise PlanError("missing column value (no defaults yet)")
+                cols[i].append(v)
+        arrays = tuple(
+            np.array(c, dtype=desc.columns[i].dtype) for i, c in enumerate(cols)
+        )
+        ts = self.oracle.write_ts()
+        n = len(stmt.rows)
+        batch = UpdateBatch.build((), arrays, np.full(n, ts), np.ones(n, dtype=np.int64))
+        self._apply_writes({item.global_id: batch}, ts)
+        return ExecResult("status", status=f"INSERT 0 {n}")
+
+    def _delete(self, stmt: ast.Delete) -> ExecResult:
+        item = self.catalog.get(stmt.table)
+        if item.kind != "table":
+            raise PlanError(f"cannot DELETE from {item.kind}")
+        # evaluate SELECT * FROM t WHERE pred, emit retractions
+        q = ast.Query(
+            ast.Select(
+                items=(ast.SelectItem(ast.Star()),),
+                from_=(ast.TableRef(stmt.table),),
+                where=stmt.where,
+            )
+        )
+        res = self._select(q)
+        if not res.rows:
+            return ExecResult("status", status="DELETE 0")
+        desc = item.desc
+        cols = tuple(
+            np.array([r[i] if not isinstance(r[i], str) else self.catalog.dict.encode(r[i]) for r in res.rows], dtype=desc.columns[i].dtype)
+            for i in range(desc.arity)
+        )
+        ts = self.oracle.write_ts()
+        n = len(res.rows)
+        batch = UpdateBatch.build((), cols, np.full(n, ts), -np.ones(n, dtype=np.int64))
+        self._apply_writes({item.global_id: batch}, ts)
+        return ExecResult("status", status=f"DELETE {n}")
+
+    def _literal_value(self, e, cdesc: ColumnDesc):
+        if isinstance(e, ast.NumberLit):
+            if cdesc.typ == ColType.NUMERIC:
+                if "." in e.value:
+                    ip, fp = e.value.split(".")
+                    fp = (fp + "0" * cdesc.scale)[: cdesc.scale]
+                    return int(ip or "0") * 10**cdesc.scale + int(fp)
+                return int(e.value) * 10**cdesc.scale
+            if "." in e.value:
+                return float(e.value)
+            return int(e.value)
+        if isinstance(e, ast.StringLit):
+            return self.catalog.dict.encode(e.value)
+        if isinstance(e, ast.BoolLit):
+            return e.value
+        if isinstance(e, ast.UnaryOp) and e.op == "-":
+            v = self._literal_value(e.expr, cdesc)
+            return -v
+        if isinstance(e, ast.DateLit):
+            from ..storage.generator import date_num
+
+            y, m, d = (int(x) for x in e.value.split("-"))
+            return int(date_num(y, m, d))
+        raise PlanError(f"unsupported literal {e!r}")
+
+    # -- write propagation -----------------------------------------------------
+    def _apply_writes(self, writes: dict[str, UpdateBatch], ts: int) -> None:
+        """Group commit: append to storage, then flow through every installed
+        dataflow in dependency order (an MV's output delta becomes visible to
+        downstream MVs at the same timestamp)."""
+        env = dict(writes)
+        for gid, batch in writes.items():
+            self.storage[gid].append(batch, ts)
+        for mv_gid, df, src_gids in self.dataflows:
+            deltas = {g: env[g] for g in src_gids if g in env}
+            if not deltas:
+                df.frontier = ts + 1
+                continue
+            results = df.step(ts, deltas)
+            out = results.get(mv_gid)
+            if out is not None and out[0] is not None:
+                env[mv_gid] = out[0]
+                self.storage[mv_gid].append(out[0], ts)
+
+    def advance(self, n_rows: int = 100) -> int:
+        """Pull one batch from every generator source and commit it."""
+        ts = self.oracle.write_ts()
+        writes: dict[str, UpdateBatch] = {}
+        for gen, gids in self.generators:
+            if isinstance(gen, AuctionGenerator):
+                batches = gen.next_tick(ts, n_rows)
+            else:
+                batches = gen.refresh(ts)
+            for t, b in batches.items():
+                if t in gids:
+                    writes[gids[t]] = b
+        if writes:
+            self._apply_writes(writes, ts)
+        return ts
+
+    # -- reads -----------------------------------------------------------------
+    def _select(self, query: ast.Query) -> ExecResult:
+        pq = self.planner.plan_query(query)
+        rel = optimize(pq.mir)
+        as_of = self.oracle.read_ts()
+
+        rows = self._peek_fast_path(rel, as_of)
+        if rows is None:
+            src_gids = sorted(_collect_gets(rel))
+            env = {g: self.storage[g].dtypes for g in src_gids}
+            desc = lower_to_dataflow("peek", rel, env, src_gids, as_of=as_of)
+            df = Dataflow(desc)
+            snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
+            df.step(as_of, snaps)
+            rows = df.peek("idx_peek")
+        rows = self._finish(rows, pq)
+        return ExecResult("rows", rows=rows, columns=tuple(c.name for c in pq.scope.cols))
+
+    def _peek_fast_path(self, rel, as_of: int):
+        """Bare Get of a maintained materialized view → read its dataflow index
+        (the reference's fast path, peek.rs:119 path (a))."""
+        if isinstance(rel, mir.MirGet):
+            for mv_gid, df, _src in self.dataflows:
+                if mv_gid == rel.id:
+                    return df.peek(f"idx_{mv_gid}", at=as_of)
+            st = self.storage.get(rel.id)
+            if st is not None:
+                out: dict = {}
+                for data, t, d in st.snapshot(as_of).to_rows():
+                    out[data] = out.get(data, 0) + d
+                rows = []
+                for data, cnt in sorted(out.items()):
+                    rows.extend([data] * cnt)
+                return rows
+        return None
+
+    def _finish(self, rows: list, pq: PlannedQuery) -> list:
+        f = pq.finishing
+        decoded = [self._decode_row(r, pq) for r in rows]
+        if f.order_by:
+            for col, desc_ in reversed(f.order_by):
+                decoded.sort(key=lambda r: r[col], reverse=desc_)
+        if f.offset:
+            decoded = decoded[f.offset :]
+        if f.limit is not None:
+            decoded = decoded[: f.limit]
+        return decoded
+
+    def _decode_row(self, row: tuple, pq: PlannedQuery) -> tuple:
+        out = []
+        for v, c in zip(row, pq.scope.cols):
+            t = c.typ
+            if t.col == ColType.STRING:
+                out.append(self.catalog.dict.decode(int(v)))
+            elif t.col == ColType.NUMERIC and t.scale:
+                out.append(v / (10**t.scale))
+            elif t.col == ColType.BOOL:
+                out.append(bool(v))
+            else:
+                out.append(v)
+        return tuple(out)
+
+    # -- introspection ---------------------------------------------------------
+    def _explain(self, stmt: ast.Explain) -> ExecResult:
+        inner = stmt.statement
+        if isinstance(inner, ast.SelectStatement):
+            pq = self.planner.plan_query(inner.query)
+            rel = optimize(pq.mir) if stmt.stage in ("optimized", "physical") else pq.mir
+            text = explain_mir(rel)
+            return ExecResult("rows", rows=[(line,) for line in text.splitlines()], columns=("plan",))
+        raise PlanError("EXPLAIN supports SELECT only")
+
+    def _show(self, stmt: ast.Show) -> ExecResult:
+        kind_map = {
+            "tables": ("table",),
+            "views": ("view",),
+            "sources": ("source",),
+            "indexes": ("index",),
+            "materialized": ("materialized_view",),
+        }
+        kinds = kind_map.get(stmt.what)
+        if kinds is None:
+            if stmt.what == "columns" and stmt.on:
+                item = self.catalog.get(stmt.on)
+                rows = [(c.name, c.typ.value) for c in item.desc.columns]
+                return ExecResult("rows", rows=rows, columns=("name", "type"))
+            raise PlanError(f"SHOW {stmt.what} unsupported")
+        rows = [(i.name,) for i in self.catalog.items.values() if i.kind in kinds]
+        return ExecResult("rows", rows=sorted(rows), columns=("name",))
+
+
+def _collect_gets(e) -> set:
+    out = set()
+
+    def go(n):
+        if isinstance(n, mir.MirGet):
+            out.add(n.id)
+        for k in mir.children(n):
+            go(k)
+
+    go(e)
+    return out
+
+
+def explain_mir(e, indent: int = 0) -> str:
+    """EXPLAIN text rendering of a MIR tree (reference: EXPLAIN PLAN)."""
+    pad = "  " * indent
+    name = type(e).__name__.replace("Mir", "")
+    extra = ""
+    if isinstance(e, mir.MirGet):
+        extra = f" {e.id}"
+    if isinstance(e, mir.MirJoin) and e.implementation is not None:
+        extra = f" type={e.implementation.kind}"
+    if isinstance(e, mir.MirReduce):
+        extra = f" keys={list(e.group_key)} aggs={[a.func for a in e.aggregates]}"
+    if isinstance(e, mir.MirTopK):
+        extra = f" group={list(e.group_key)} limit={e.limit}"
+    lines = [f"{pad}{name}{extra}"]
+    for k in mir.children(e):
+        lines.append(explain_mir(k, indent + 1))
+    return "\n".join(lines)

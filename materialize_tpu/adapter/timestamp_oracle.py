@@ -1,0 +1,28 @@
+"""Timestamp oracle: linearizable read/write timestamp allocation.
+
+The single-process analogue of the reference's `mz-timestamp-oracle`
+(src/timestamp-oracle/src/lib.rs:41-46): reads observe exactly the writes
+with earlier timestamps; write timestamps are strictly monotonic. The
+production reference backs this with CRDB/Postgres; here it is the
+coordinator's single-threaded counter, with the same interface shape so a
+distributed impl can replace it.
+"""
+
+from __future__ import annotations
+
+
+class TimestampOracle:
+    def __init__(self, start: int = 0):
+        self._ts = start
+
+    def write_ts(self) -> int:
+        """Allocate a fresh write timestamp (strictly monotonic)."""
+        self._ts += 1
+        return self._ts
+
+    def read_ts(self) -> int:
+        """Latest timestamp whose writes are complete."""
+        return self._ts
+
+    def apply_write(self, ts: int) -> None:
+        self._ts = max(self._ts, ts)

@@ -541,6 +541,11 @@ def _expr_dtype(expr, col_dtypes):
     if isinstance(expr, s.CallVariadic):
         if expr.func in ("and", "or"):
             return np.dtype(np.bool_)
+        if expr.func == "if":
+            return np.promote_types(
+                _expr_dtype(expr.exprs[1], col_dtypes),
+                _expr_dtype(expr.exprs[2], col_dtypes),
+            )
         dts = [_expr_dtype(e, col_dtypes) for e in expr.exprs]
         out = dts[0]
         for d in dts[1:]:

@@ -21,7 +21,79 @@ import jax.numpy as jnp
 
 from ..repr.batch import PAD_TIME, UpdateBatch
 from ..repr.hashing import PAD_HASH
-from .scalar import ScalarExpr, eval_expr, expr_columns
+from .scalar import (
+    CallBinary,
+    CallUnary,
+    CallVariadic,
+    Column,
+    Literal,
+    ScalarExpr,
+    eval_expr,
+    expr_columns,
+)
+
+
+def substitute_columns(e: ScalarExpr, mapping) -> ScalarExpr:
+    """Rewrite Column indices through `mapping` (list or dict)."""
+    if isinstance(e, Column):
+        return Column(mapping[e.index])
+    if isinstance(e, Literal):
+        return e
+    if isinstance(e, CallUnary):
+        return CallUnary(e.func, substitute_columns(e.expr, mapping))
+    if isinstance(e, CallBinary):
+        return CallBinary(
+            e.func,
+            substitute_columns(e.left, mapping),
+            substitute_columns(e.right, mapping),
+        )
+    if isinstance(e, CallVariadic):
+        return CallVariadic(
+            e.func, tuple(substitute_columns(x, mapping) for x in e.exprs)
+        )
+    raise TypeError(f"not a ScalarExpr: {e!r}")
+
+
+class MfpBuilder:
+    """Incrementally fuse Map/Filter/Project steps into one MapFilterProject.
+
+    Tracks the current output→storage column mapping so later expressions are
+    rewritten into the flat (input ++ maps) column space, mirroring the
+    reference's MapFilterProject builder (src/expr/src/linear.rs:45).
+    """
+
+    def __init__(self, input_arity: int):
+        self.input_arity = input_arity
+        self.maps: list = []
+        self.predicates: list = []
+        self.proj: list[int] = list(range(input_arity))
+
+    def add_maps(self, exprs) -> None:
+        for e in exprs:
+            remapped = substitute_columns(e, self.proj)
+            self.maps.append(remapped)
+            self.proj.append(self.input_arity + len(self.maps) - 1)
+
+    def add_predicates(self, exprs) -> None:
+        for e in exprs:
+            self.predicates.append(substitute_columns(e, self.proj))
+
+    def project(self, outputs) -> None:
+        self.proj = [self.proj[i] for i in outputs]
+
+    def absorb(self, mfp: "MapFilterProject") -> None:
+        self.add_maps(mfp.map_exprs)
+        self.add_predicates(mfp.predicates)
+        if mfp.projection is not None:
+            self.project(mfp.projection)
+
+    def finish(self) -> "MapFilterProject":
+        return MapFilterProject(
+            self.input_arity,
+            tuple(self.maps),
+            tuple(self.predicates),
+            tuple(self.proj),
+        )
 
 
 @dataclass(frozen=True)
@@ -59,18 +131,25 @@ class MapFilterProject:
         """
         cols = list(batch.vals)
         n = batch.cap
-        err = jnp.zeros((n,), dtype=jnp.int32)
+        map_err = jnp.zeros((n,), dtype=jnp.int32)
         for e in self.map_exprs:
             v, ev = eval_expr(e, cols, n)
-            err = jnp.maximum(err, ev)
+            map_err = jnp.maximum(map_err, ev)
             cols.append(v)
 
         keep = jnp.ones((n,), dtype=jnp.bool_)
+        pred_err = jnp.zeros((n,), dtype=jnp.int32)
         for p in self.predicates:
             v, ev = eval_expr(p, cols, n)
-            err = jnp.maximum(err, ev)
-            keep = keep & v.astype(jnp.bool_)
+            pred_err = jnp.maximum(pred_err, ev)
+            # an erroring predicate doesn't filter (the row errors instead)
+            keep = keep & (v.astype(jnp.bool_) | (ev != 0))
 
+        # Guard semantics: a row only errors if it would otherwise survive the
+        # filters — `WHERE b <> 0` really does guard `SELECT a / b`
+        # (reference MFP evaluates predicates before dependent maps,
+        # src/expr/src/linear.rs; we get the same visible behavior by masking).
+        err = jnp.where(keep, jnp.maximum(map_err, pred_err), 0)
         live = batch.live
         err = jnp.where(live, err, 0)  # padding can't error
         ok_mask = keep & (err == 0)
@@ -94,6 +173,14 @@ class MapFilterProject:
             diffs=jnp.where(err_mask, batch.diffs, 0),
         )
         return oks, errs
+
+    @staticmethod
+    def compose(outer: "MapFilterProject", inner: "MapFilterProject") -> "MapFilterProject":
+        """outer ∘ inner as one MFP (the reference's MapFilterProject fusion)."""
+        b = MfpBuilder(inner.input_arity)
+        b.absorb(inner)
+        b.absorb(outer)
+        return b.finish()
 
     def demanded_columns(self) -> set[int]:
         """Input columns the MFP actually reads (for projection pushdown)."""

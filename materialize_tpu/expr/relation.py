@@ -1,0 +1,156 @@
+"""MIR — the mid-level relational IR the optimizer works on.
+
+The TPU build's analogue of the reference's `MirRelationExpr`
+(src/expr/src/relation.rs:100-309). Variants kept: Constant, Get, Map,
+Filter, Project, Join, Reduce, TopK, Negate, Threshold, Union, Distinct
+(a Reduce special case kept explicit for planning clarity). Correlated
+subqueries are eliminated before MIR (HIR decorrelation lives in sql/plan.py
+as in src/sql/src/plan/lowering.rs).
+
+All nodes are frozen dataclasses; transforms rebuild rather than mutate.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+from .scalar import ScalarExpr
+
+
+@dataclass(frozen=True)
+class MirConstant:
+    rows: tuple  # ((data...), diff) pairs, all at the dataflow's as_of
+    dtypes: tuple
+
+
+@dataclass(frozen=True)
+class MirGet:
+    id: str
+    arity: int
+
+
+@dataclass(frozen=True)
+class MirMap:
+    input: Any
+    exprs: tuple  # appended columns
+
+
+@dataclass(frozen=True)
+class MirFilter:
+    input: Any
+    predicates: tuple
+
+
+@dataclass(frozen=True)
+class MirProject:
+    input: Any
+    outputs: tuple  # column indices
+
+
+@dataclass(frozen=True)
+class MirJoin:
+    """N-way join with equivalence classes of column references.
+
+    equivalences: tuple of tuples of (input_idx, col_idx) — all members of a
+    class must be equal. Global column order = concatenation of input columns
+    (the reference's flat join column space, relation.rs Join docs).
+    """
+
+    inputs: tuple
+    equivalences: tuple
+    # filled by the JoinImplementation transform (join_implementation.rs):
+    implementation: Optional[Any] = None  # "linear" | "delta" plan object
+
+
+@dataclass(frozen=True)
+class MirAggregate:
+    """func in {sum,count,min,max,avg is planned as sum/count}; expr over input cols."""
+
+    func: str
+    expr: ScalarExpr
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class MirReduce:
+    input: Any
+    group_key: tuple  # column indices (scalar-expr keys are pre-Mapped)
+    aggregates: tuple  # of MirAggregate
+
+
+@dataclass(frozen=True)
+class MirTopK:
+    input: Any
+    group_key: tuple
+    order_by: tuple  # ((col, desc), ...)
+    limit: Optional[int]
+    offset: int = 0
+
+
+@dataclass(frozen=True)
+class MirNegate:
+    input: Any
+
+
+@dataclass(frozen=True)
+class MirThreshold:
+    input: Any
+
+
+@dataclass(frozen=True)
+class MirUnion:
+    inputs: tuple
+
+
+@dataclass(frozen=True)
+class MirDistinct:
+    input: Any
+
+
+MirExpr = Any
+
+
+def arity(e: MirExpr) -> int:
+    """Number of output columns."""
+    if isinstance(e, MirConstant):
+        return len(e.dtypes)
+    if isinstance(e, MirGet):
+        return e.arity
+    if isinstance(e, MirMap):
+        return arity(e.input) + len(e.exprs)
+    if isinstance(e, MirFilter):
+        return arity(e.input)
+    if isinstance(e, MirProject):
+        return len(e.outputs)
+    if isinstance(e, MirJoin):
+        return sum(arity(i) for i in e.inputs)
+    if isinstance(e, MirReduce):
+        return len(e.group_key) + len(e.aggregates)
+    if isinstance(e, MirTopK):
+        return arity(e.input)
+    if isinstance(e, (MirNegate, MirThreshold, MirDistinct)):
+        return arity(e.input) if not isinstance(e, MirDistinct) else arity(e.input)
+    if isinstance(e, MirUnion):
+        return arity(e.inputs[0])
+    raise TypeError(f"not a MirExpr: {e!r}")
+
+
+def children(e: MirExpr) -> tuple:
+    if isinstance(e, (MirConstant, MirGet)):
+        return ()
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct)):
+        return (e.input,)
+    if isinstance(e, (MirJoin, MirUnion)):
+        return tuple(e.inputs)
+    raise TypeError(f"not a MirExpr: {e!r}")
+
+
+def with_children(e: MirExpr, new: tuple) -> MirExpr:
+    if isinstance(e, (MirConstant, MirGet)):
+        return e
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct)):
+        return replace(e, input=new[0])
+    if isinstance(e, (MirJoin, MirUnion)):
+        return replace(e, inputs=tuple(new))
+    raise TypeError(f"not a MirExpr: {e!r}")

@@ -155,6 +155,9 @@ def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             for v in vals[1:]:
                 out = out | v
             return out, err
+        if f == "if":
+            cond, then_, else_ = vals
+            return jnp.where(cond.astype(jnp.bool_), then_, else_), err
         if f == "greatest":
             out = vals[0]
             for v in vals[1:]:

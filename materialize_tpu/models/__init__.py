@@ -1,0 +1,3 @@
+from . import auction, tpch
+
+__all__ = ["auction", "tpch"]

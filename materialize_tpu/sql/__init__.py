@@ -1,0 +1,14 @@
+from . import ast
+from .lexer import lex
+from .parser import ParseError, parse_statement, parse_statements
+from .plan import PlanError, Planner
+
+__all__ = [
+    "ast",
+    "lex",
+    "ParseError",
+    "parse_statement",
+    "parse_statements",
+    "PlanError",
+    "Planner",
+]

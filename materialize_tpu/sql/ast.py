@@ -1,0 +1,267 @@
+"""SQL AST — statements and expressions.
+
+The analogue of the reference's `mz-sql-parser` AST (src/sql-parser/src/ast/).
+Only the statement surface the engine executes is modeled; everything is a
+frozen dataclass for hashability and easy matching.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+# -- scalar expressions ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Ident:
+    """Possibly-qualified name: a.b → qualifier 'a', name 'b'."""
+
+    name: str
+    qualifier: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class NumberLit:
+    value: str  # textual; planner decides int vs numeric
+
+
+@dataclass(frozen=True)
+class StringLit:
+    value: str
+
+
+@dataclass(frozen=True)
+class BoolLit:
+    value: bool
+
+
+@dataclass(frozen=True)
+class NullLit:
+    pass
+
+
+@dataclass(frozen=True)
+class DateLit:
+    value: str  # 'YYYY-MM-DD'
+
+
+@dataclass(frozen=True)
+class UnaryOp:
+    op: str  # - | not
+    expr: Any
+
+
+@dataclass(frozen=True)
+class BinaryOp:
+    op: str  # + - * / % = <> < <= > >= and or like
+    left: Any
+    right: Any
+
+
+@dataclass(frozen=True)
+class FuncCall:
+    name: str
+    args: tuple
+    distinct: bool = False
+    is_star: bool = False  # count(*)
+
+
+@dataclass(frozen=True)
+class Cast:
+    expr: Any
+    typ: str
+
+
+@dataclass(frozen=True)
+class Case:
+    operand: Optional[Any]
+    whens: tuple  # ((cond, result), ...)
+    else_: Optional[Any]
+
+
+@dataclass(frozen=True)
+class InList:
+    expr: Any
+    items: tuple
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Between:
+    expr: Any
+    low: Any
+    high: Any
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull:
+    expr: Any
+    negated: bool = False
+
+
+@dataclass(frozen=True)
+class Star:
+    qualifier: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class Subquery:
+    """Scalar or EXISTS subquery (decorrelated during HIR lowering)."""
+
+    query: Any
+    exists: bool = False
+
+
+# -- relations ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TableRef:
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SubqueryRef:
+    query: Any
+    alias: str
+
+
+@dataclass(frozen=True)
+class JoinClause:
+    left: Any
+    right: Any
+    kind: str  # inner | left | right | full | cross
+    on: Optional[Any]
+
+
+@dataclass(frozen=True)
+class SelectItem:
+    expr: Any
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class OrderByItem:
+    expr: Any
+    desc: bool = False
+
+
+@dataclass(frozen=True)
+class Select:
+    items: tuple
+    from_: tuple  # relation refs (comma list, each possibly a JoinClause tree)
+    where: Optional[Any] = None
+    group_by: tuple = ()
+    having: Optional[Any] = None
+    distinct: bool = False
+
+
+@dataclass(frozen=True)
+class Query:
+    """Select plus set-ops / ordering / limit."""
+
+    body: Any  # Select | SetOp
+    order_by: tuple = ()
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclass(frozen=True)
+class SetOp:
+    op: str  # union | union_all | except | except_all | intersect | intersect_all
+    left: Any
+    right: Any
+
+
+# -- statements --------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ColumnDef:
+    name: str
+    typ: str
+    not_null: bool = False
+
+
+@dataclass(frozen=True)
+class CreateTable:
+    name: str
+    columns: tuple
+
+
+@dataclass(frozen=True)
+class CreateSource:
+    name: str
+    generator: str  # auction | tpch | counter
+    options: tuple = ()  # ((key, value), ...)
+
+
+@dataclass(frozen=True)
+class CreateMaterializedView:
+    name: str
+    query: Query
+
+
+@dataclass(frozen=True)
+class CreateView:
+    name: str
+    query: Query
+
+
+@dataclass(frozen=True)
+class CreateIndex:
+    name: Optional[str]
+    on: str
+    key_columns: tuple  # column names; empty = default key
+
+
+@dataclass(frozen=True)
+class Insert:
+    table: str
+    columns: tuple
+    rows: tuple  # tuple of tuples of exprs
+
+
+@dataclass(frozen=True)
+class Delete:
+    table: str
+    where: Optional[Any]
+
+
+@dataclass(frozen=True)
+class Update:
+    table: str
+    assignments: tuple  # ((col, expr), ...)
+    where: Optional[Any]
+
+
+@dataclass(frozen=True)
+class SelectStatement:
+    query: Query
+
+
+@dataclass(frozen=True)
+class Explain:
+    stage: str  # plan | optimized | physical
+    statement: Any
+
+
+@dataclass(frozen=True)
+class Show:
+    what: str  # tables | views | sources | indexes | columns
+    on: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class DropObject:
+    kind: str  # table | view | source | index | materialized view
+    name: str
+    if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class Subscribe:
+    query: Query

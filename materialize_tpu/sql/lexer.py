@@ -1,0 +1,104 @@
+"""SQL lexer — PostgreSQL-flavored token stream.
+
+The analogue of the reference's `mz-sql-lexer` (src/sql-lexer): keywords are
+case-insensitive, identifiers fold to lowercase unless double-quoted, strings
+are single-quoted with '' escaping.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Token:
+    kind: str  # KW | IDENT | NUMBER | STRING | OP | EOF
+    value: str
+    pos: int
+
+
+KEYWORDS = {
+    "select", "from", "where", "group", "by", "having", "order", "limit",
+    "offset", "as", "and", "or", "not", "join", "inner", "left", "right",
+    "full", "outer", "on", "cross", "union", "all", "except", "intersect",
+    "distinct", "create", "materialized", "view", "table", "source", "index",
+    "insert", "into", "values", "delete", "drop", "show", "explain", "sink",
+    "in", "exists", "between", "like", "is", "null", "true", "false", "case",
+    "when", "then", "else", "end", "cast", "asc", "desc", "with", "load",
+    "generator", "for", "auction", "tpch", "counter", "subscribe", "to",
+    "tables", "columns", "indexes", "sources", "views", "nulls", "first",
+    "last", "date", "interval", "default", "if", "scale", "factor", "cluster",
+    "replicas", "replica", "size", "set", "alter", "system", "update",
+}
+
+_TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||", "::"}
+
+
+def lex(sql: str) -> list[Token]:
+    toks: list[Token] = []
+    i, n = 0, len(sql)
+    while i < n:
+        c = sql[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "-" and i + 1 < n and sql[i + 1] == "-":  # line comment
+            while i < n and sql[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and i + 1 < n and sql[i + 1] == "*":
+            j = sql.find("*/", i + 2)
+            i = n if j < 0 else j + 2
+            continue
+        if c == "'":
+            j = i + 1
+            buf = []
+            while j < n:
+                if sql[j] == "'" and j + 1 < n and sql[j + 1] == "'":
+                    buf.append("'")
+                    j += 2
+                elif sql[j] == "'":
+                    break
+                else:
+                    buf.append(sql[j])
+                    j += 1
+            toks.append(Token("STRING", "".join(buf), i))
+            i = j + 1
+            continue
+        if c == '"':
+            j = sql.find('"', i + 1)
+            if j < 0:
+                raise ValueError(f"unterminated quoted identifier at {i}")
+            toks.append(Token("IDENT", sql[i + 1 : j], i))
+            i = j + 1
+            continue
+        if c.isdigit() or (c == "." and i + 1 < n and sql[i + 1].isdigit()):
+            j = i
+            seen_dot = False
+            while j < n and (sql[j].isdigit() or (sql[j] == "." and not seen_dot)):
+                if sql[j] == ".":
+                    seen_dot = True
+                j += 1
+            toks.append(Token("NUMBER", sql[i:j], i))
+            i = j
+            continue
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (sql[j].isalnum() or sql[j] == "_"):
+                j += 1
+            word = sql[i:j].lower()
+            toks.append(Token("KW" if word in KEYWORDS else "IDENT", word, i))
+            i = j
+            continue
+        two = sql[i : i + 2]
+        if two in _TWO_CHAR_OPS:
+            toks.append(Token("OP", two, i))
+            i += 2
+            continue
+        if c in "+-*/%(),.;=<>[]":
+            toks.append(Token("OP", c, i))
+            i += 1
+            continue
+        raise ValueError(f"unexpected character {c!r} at {i}")
+    toks.append(Token("EOF", "", n))
+    return toks

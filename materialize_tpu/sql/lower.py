@@ -1,0 +1,258 @@
+"""MIR → LIR lowering: produce a renderable DataflowDescription.
+
+The analogue of the reference's plan lowering
+(src/compute-types/src/plan/lowering.rs:136): Map/Filter/Project chains fuse
+into single MFPs, joins take their physical plan from the
+JoinImplementation transform, reduces split into accumulable and
+hierarchical parts (collation via a join of partial reduces, mirroring
+ReducePlan::Collation, src/compute-types/src/plan/reduce.rs:386).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+import numpy as np
+
+from ..dataflow import BuildDesc, DataflowDescription
+from ..dataflow import plan as lir
+from ..expr import relation as mir
+from ..expr.linear import MapFilterProject, MfpBuilder, substitute_columns
+from ..expr.scalar import CallBinary, CallUnary, Column, Literal
+from ..ops.reduce import AggregateExpr
+from ..ops.topk import TopKPlan
+from ..transform.join_implementation import plan_join_implementation
+
+I64 = np.dtype(np.int64)
+F32 = np.dtype(np.float32)
+
+
+class Lowerer:
+    def __init__(self, dtypes_env: dict):
+        self.env = dict(dtypes_env)
+
+    # -- dtype inference ------------------------------------------------------
+    def dtypes(self, e) -> tuple:
+        if isinstance(e, mir.MirGet):
+            return tuple(self.env[e.id])
+        if isinstance(e, mir.MirConstant):
+            return tuple(e.dtypes)
+        if isinstance(e, mir.MirMap):
+            base = list(self.dtypes(e.input))
+            for ex in e.exprs:
+                base.append(_expr_np_dtype(ex, base))
+            return tuple(base)
+        if isinstance(e, mir.MirFilter):
+            return self.dtypes(e.input)
+        if isinstance(e, mir.MirProject):
+            base = self.dtypes(e.input)
+            return tuple(base[i] for i in e.outputs)
+        if isinstance(e, mir.MirJoin):
+            out = []
+            for i in e.inputs:
+                out.extend(self.dtypes(i))
+            return tuple(out)
+        if isinstance(e, mir.MirReduce):
+            base = self.dtypes(e.input)
+            out = [base[i] for i in e.group_key]
+            for a in e.aggregates:
+                if a.func == "count":
+                    out.append(I64)
+                else:
+                    out.append(_expr_np_dtype(a.expr, list(base)))
+            return tuple(out)
+        if isinstance(e, mir.MirTopK):
+            return self.dtypes(e.input)
+        if isinstance(e, (mir.MirNegate, mir.MirThreshold, mir.MirDistinct)):
+            return self.dtypes(e.input)
+        if isinstance(e, mir.MirUnion):
+            return self.dtypes(e.inputs[0])
+        raise TypeError(f"dtypes: {type(e).__name__}")
+
+    # -- lowering -------------------------------------------------------------
+    def lower(self, e):
+        """MIR expr → LIR expr."""
+        # fuse M/F/P chains into one MFP over the chain's base
+        if isinstance(e, (mir.MirMap, mir.MirFilter, mir.MirProject)):
+            chain = []
+            base = e
+            while isinstance(base, (mir.MirMap, mir.MirFilter, mir.MirProject)):
+                chain.append(base)
+                base = base.input
+            b = MfpBuilder(mir.arity(base))
+            for node in reversed(chain):
+                if isinstance(node, mir.MirMap):
+                    b.add_maps(node.exprs)
+                elif isinstance(node, mir.MirFilter):
+                    b.add_predicates(node.predicates)
+                else:
+                    b.project(node.outputs)
+            mfp = b.finish()
+            lowered = self.lower(base)
+            if mfp.is_identity():
+                return lowered
+            return lir.Mfp(lowered, mfp)
+        if isinstance(e, mir.MirGet):
+            return lir.Get(e.id)
+        if isinstance(e, mir.MirConstant):
+            rows = tuple((data, 0, diff) for data, diff in e.rows)
+            return lir.Constant(rows, tuple(e.dtypes))
+        if isinstance(e, mir.MirJoin):
+            impl = e.implementation or plan_join_implementation(e)
+            inputs = tuple(self.lower(i) for i in e.inputs)
+            closure = None
+            if impl.residual_equalities:
+                total = sum(mir.arity(i) for i in e.inputs)
+                b = MfpBuilder(total)
+                b.add_predicates(
+                    tuple(
+                        CallBinary("eq", Column(a), Column(c))
+                        for a, c in impl.residual_equalities
+                    )
+                )
+                closure = b.finish()
+            return lir.Join(inputs=inputs, plan=impl.lir_plan, closure=closure)
+        if isinstance(e, mir.MirReduce):
+            return self.lower_reduce(e)
+        if isinstance(e, mir.MirTopK):
+            return lir.TopK(
+                self.lower(e.input),
+                TopKPlan(
+                    group_cols=tuple(e.group_key),
+                    order_by=tuple(e.order_by),
+                    limit=e.limit,
+                    offset=e.offset,
+                ),
+            )
+        if isinstance(e, mir.MirNegate):
+            return lir.Negate(self.lower(e.input))
+        if isinstance(e, mir.MirThreshold):
+            return lir.Threshold(self.lower(e.input))
+        if isinstance(e, mir.MirDistinct):
+            n = mir.arity(e.input)
+            return lir.Reduce(
+                self.lower(e.input), key_cols=tuple(range(n)), distinct=True
+            )
+        if isinstance(e, mir.MirUnion):
+            return lir.Union(tuple(self.lower(i) for i in e.inputs))
+        raise TypeError(f"lower: {type(e).__name__}")
+
+    def lower_reduce(self, e: mir.MirReduce):
+        """Split aggregates into accumulable and hierarchical parts.
+
+        Mirrors ReducePlan construction (plan/reduce.rs:130): Accumulable for
+        sum/count, Hierarchical (top-1 kernel) for min/max, Collation (a join
+        of the partial reduces on the group key) when mixed.
+        """
+        in_dtypes = list(self.dtypes(e.input))
+        key = tuple(e.group_key)
+        if not e.aggregates:
+            return lir.Reduce(self.lower(e.input), key_cols=key, distinct=True)
+
+        parts = []  # (agg_indices, lir builder fn)
+        acc_idx = [i for i, a in enumerate(e.aggregates) if a.func in ("sum", "count")]
+        hier_idx = [i for i, a in enumerate(e.aggregates) if a.func in ("min", "max")]
+        unknown = [a.func for a in e.aggregates if a.func not in ("sum", "count", "min", "max")]
+        if unknown:
+            raise NotImplementedError(f"aggregates {unknown}")
+
+        lowered_in = self.lower(e.input)
+
+        def accumulable_part():
+            aggs = []
+            for i in acc_idx:
+                a = e.aggregates[i]
+                if a.func == "count":
+                    aggs.append(AggregateExpr("count", Literal(1)))
+                else:
+                    dt = _expr_np_dtype(a.expr, in_dtypes)
+                    accum = "float32" if dt == F32 else "int64"
+                    aggs.append(AggregateExpr("sum", a.expr, accum))
+            return lir.Reduce(lowered_in, key_cols=key, aggs=tuple(aggs))
+
+        def hierarchical_part(agg_i: int):
+            a = e.aggregates[agg_i]
+            n_in = len(in_dtypes)
+            # materialize the agg expr as a column, top-1 it per group
+            b = MfpBuilder(n_in)
+            b.add_maps((a.expr,))
+            b.project(tuple(key) + (n_in,))
+            pre = lir.Mfp(lowered_in, b.finish())
+            nk = len(key)
+            topk = lir.TopK(
+                pre,
+                TopKPlan(
+                    group_cols=tuple(range(nk)),
+                    order_by=((nk, a.func == "max"),),
+                    limit=1,
+                ),
+            )
+            return topk
+
+        if acc_idx and not hier_idx:
+            return accumulable_part()
+        if len(hier_idx) == 1 and not acc_idx:
+            part = hierarchical_part(hier_idx[0])
+            return part
+        # collation: join partial reduces on the group key
+        partials = []  # (lir expr, agg indices, out arity)
+        if acc_idx:
+            partials.append((accumulable_part(), acc_idx))
+        for hi in hier_idx:
+            partials.append((hierarchical_part(hi), [hi]))
+        nk = len(key)
+        # every partial outputs (key cols ++ its agg cols)
+        stages = []
+        arities = [nk + len(p[1]) for p in partials]
+        for i in range(1, len(partials)):
+            prior = sum(arities[:i])
+            stages.append(
+                lir.JoinStage(
+                    stream_key=tuple(range(nk)),
+                    lookup_key=tuple(range(nk)),
+                )
+            )
+        # closure: project canonical (keys, aggs in declaration order)
+        total = sum(arities)
+        pos_of_agg: dict[int, int] = {}
+        off = 0
+        for part_expr, idxs in partials:
+            for j, agg_i in enumerate(idxs):
+                pos_of_agg[agg_i] = off + nk + j
+            off += nk + len(idxs)
+        proj = tuple(range(nk)) + tuple(
+            pos_of_agg[i] for i in range(len(e.aggregates))
+        )
+        b = MfpBuilder(total)
+        b.project(proj)
+        return lir.Join(
+            inputs=tuple(p[0] for p in partials),
+            plan=lir.LinearJoinPlan(stages=tuple(stages)),
+            closure=b.finish(),
+        )
+
+
+def _expr_np_dtype(expr, col_dtypes):
+    from ..dataflow.runtime import _expr_dtype
+
+    return _expr_dtype(expr, col_dtypes)
+
+
+def lower_to_dataflow(
+    obj_id: str,
+    mir_expr,
+    dtypes_env: dict,
+    source_ids: list[str],
+    index_key: tuple = (),
+    as_of: int = 0,
+) -> DataflowDescription:
+    """Build a one-object DataflowDescription for `mir_expr`."""
+    lo = Lowerer(dtypes_env)
+    plan = lo.lower(mir_expr)
+    out_dtypes = lo.dtypes(mir_expr)
+    return DataflowDescription(
+        source_imports={sid: tuple(dtypes_env[sid]) for sid in source_ids},
+        objects_to_build=[BuildDesc(obj_id, plan, out_dtypes)],
+        index_exports={f"idx_{obj_id}": (obj_id, tuple(index_key))},
+        as_of=as_of,
+    )

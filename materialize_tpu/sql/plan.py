@@ -1,0 +1,726 @@
+"""SQL planning: AST → MIR with name resolution and typing.
+
+The analogue of the reference's `mz-sql` plan pipeline (name resolution in
+names.rs, HIR construction in plan/query.rs, HIR→MIR decorrelation in
+plan/lowering.rs). This build plans directly to MIR; correlated subqueries are
+not yet decorrelated (uncorrelated EXISTS/IN become semijoins).
+
+NUMERIC is fixed-point i64 with a tracked decimal scale: literals like 0.05
+plan as Literal(5)@scale2, multiplication adds scales, addition aligns them —
+exact arithmetic on device, mirroring the reference's libdecnumber NUMERIC
+without an f64 dependency (TPUs have no f64 ALU).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+import numpy as np
+
+from ..expr import relation as mir
+from ..expr.scalar import CallBinary, CallUnary, CallVariadic, Column, Literal
+from ..repr.types import ColType, ColumnDesc, RelationDesc
+from . import ast
+
+
+class PlanError(ValueError):
+    pass
+
+
+@dataclass(frozen=True)
+class PType:
+    """Planned column type: engine ColType plus NUMERIC scale."""
+
+    col: ColType
+    scale: int = 0
+
+    @property
+    def dtype(self) -> np.dtype:
+        return self.col.dtype
+
+
+INT = PType(ColType.INT64)
+BOOL = PType(ColType.BOOL)
+STRING = PType(ColType.STRING)
+FLOAT = PType(ColType.FLOAT64)
+DATE = PType(ColType.TIMESTAMP)
+
+
+@dataclass(frozen=True)
+class ScopeCol:
+    qualifier: Optional[str]
+    name: Optional[str]
+    typ: PType
+
+
+@dataclass
+class Scope:
+    cols: list
+
+    def resolve(self, name: str, qualifier: Optional[str]) -> int:
+        matches = [
+            i
+            for i, c in enumerate(self.cols)
+            if c.name == name and (qualifier is None or c.qualifier == qualifier)
+        ]
+        if not matches:
+            raise PlanError(f"unknown column: {qualifier + '.' if qualifier else ''}{name}")
+        if len(matches) > 1:
+            raise PlanError(f"ambiguous column: {name}")
+        return matches[0]
+
+    def __add__(self, other: "Scope") -> "Scope":
+        return Scope(self.cols + other.cols)
+
+
+@dataclass
+class RowSetFinishing:
+    """Host-side ordering/limit applied to peek results (the reference's
+    RowSetFinishing applied in the adapter, not the dataflow)."""
+
+    order_by: tuple = ()  # ((col_idx, desc), ...)
+    limit: Optional[int] = None
+    offset: int = 0
+
+
+@dataclass
+class PlannedQuery:
+    mir: Any
+    scope: Scope  # output columns with names/types
+    finishing: RowSetFinishing
+
+    @property
+    def desc(self) -> RelationDesc:
+        return RelationDesc(
+            tuple(
+                ColumnDesc(c.name or f"column{i+1}", c.typ.col, scale=c.typ.scale)
+                for i, c in enumerate(self.scope.cols)
+            )
+        )
+
+    @property
+    def dtypes(self) -> tuple:
+        return tuple(c.typ.dtype for c in self.scope.cols)
+
+
+_AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+
+
+@dataclass(frozen=True)
+class _AggRef:
+    """Internal AST placeholder for an extracted aggregate call."""
+
+    index: int
+
+
+def _rescale(e, from_scale: int, to_scale: int):
+    if from_scale == to_scale:
+        return e
+    if to_scale > from_scale:
+        return CallBinary("mul", e, Literal(10 ** (to_scale - from_scale)))
+    return CallBinary("floordiv", e, Literal(10 ** (from_scale - to_scale)))
+
+
+class Planner:
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    # -- expression planning -------------------------------------------------
+    def plan_scalar(self, e, scope: Scope):
+        """AST expr → (ScalarExpr, PType)."""
+        if isinstance(e, _AggRef):
+            raise PlanError("aggregate not allowed here")
+        if isinstance(e, _PostCol):
+            return Column(e.index), scope.cols[e.index].typ
+        if isinstance(e, _PostAvg):
+            num = _to_float(Column(e.sum_col), e.vt)
+            den = CallUnary("cast_float", Column(e.cnt_col))
+            return CallBinary("div", num, den), FLOAT
+        if isinstance(e, ast.Ident):
+            i = scope.resolve(e.name, e.qualifier)
+            return Column(i), scope.cols[i].typ
+        if isinstance(e, ast.NumberLit):
+            if "." in e.value:
+                intpart, frac = e.value.split(".")
+                scale = len(frac)
+                v = int(intpart or "0") * 10**scale + int(frac)
+                return Literal(v), PType(ColType.NUMERIC, scale)
+            return Literal(int(e.value)), INT
+        if isinstance(e, ast.StringLit):
+            return Literal(self.catalog.dict.encode(e.value)), STRING
+        if isinstance(e, ast.BoolLit):
+            return Literal(e.value, "bool"), BOOL
+        if isinstance(e, ast.NullLit):
+            raise PlanError("NULL literals not supported yet (non-null engine)")
+        if isinstance(e, ast.DateLit):
+            from ..storage.generator import date_num
+
+            y, m, d = (int(x) for x in e.value.split("-"))
+            return Literal(int(date_num(y, m, d))), DATE
+        if isinstance(e, ast.UnaryOp):
+            v, t = self.plan_scalar(e.expr, scope)
+            if e.op == "-":
+                return CallUnary("neg", v), t
+            if e.op == "not":
+                return CallUnary("not", v), BOOL
+            raise PlanError(f"unary {e.op}")
+        if isinstance(e, ast.BinaryOp):
+            return self._plan_binary(e, scope)
+        if isinstance(e, ast.Between):
+            lo = ast.BinaryOp(">=", e.expr, e.low)
+            hi = ast.BinaryOp("<=", e.expr, e.high)
+            both = ast.BinaryOp("and", lo, hi)
+            if e.negated:
+                both = ast.UnaryOp("not", both)
+            return self.plan_scalar(both, scope)
+        if isinstance(e, ast.InList):
+            if any(isinstance(i, ast.Subquery) for i in e.items):
+                raise PlanError("IN (SELECT …) must be planned at relation level")
+            ors = None
+            for item in e.items:
+                eq = ast.BinaryOp("=", e.expr, item)
+                ors = eq if ors is None else ast.BinaryOp("or", ors, eq)
+            if e.negated:
+                ors = ast.UnaryOp("not", ors)
+            return self.plan_scalar(ors, scope)
+        if isinstance(e, ast.IsNull):
+            # no NULLs in the engine yet: IS NULL = false, IS NOT NULL = true
+            return Literal(bool(e.negated), "bool"), BOOL
+        if isinstance(e, ast.Case):
+            return self._plan_case(e, scope)
+        if isinstance(e, ast.Cast):
+            return self._plan_cast(e, scope)
+        if isinstance(e, ast.FuncCall):
+            return self._plan_func(e, scope)
+        if isinstance(e, ast.Subquery):
+            raise PlanError("scalar subqueries not supported yet")
+        raise PlanError(f"unsupported expression: {e!r}")
+
+    def _plan_binary(self, e: ast.BinaryOp, scope: Scope):
+        op = e.op
+        if op in ("and", "or"):
+            l, _ = self.plan_scalar(e.left, scope)
+            r, _ = self.plan_scalar(e.right, scope)
+            return CallBinary(op, l, r), BOOL
+        l, lt = self.plan_scalar(e.left, scope)
+        r, rt = self.plan_scalar(e.right, scope)
+        if op in ("=", "<>", "<", "<=", ">", ">="):
+            l, r, _t = self._align(l, lt, r, rt)
+            fn = {"=": "eq", "<>": "ne", "<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}[op]
+            return CallBinary(fn, l, r), BOOL
+        if op in ("+", "-"):
+            l, r, t = self._align(l, lt, r, rt)
+            return CallBinary("add" if op == "+" else "sub", l, r), t
+        if op == "*":
+            t = self._arith_type(lt, rt)
+            if t.col == ColType.NUMERIC:
+                return CallBinary("mul", l, r), PType(ColType.NUMERIC, lt.scale + rt.scale)
+            return CallBinary("mul", l, r), t
+        if op == "/":
+            t = self._arith_type(lt, rt)
+            if t.col == ColType.FLOAT64:
+                return CallBinary("div", l, r), FLOAT
+            if t.col == ColType.NUMERIC:
+                # numeric division: scale result to max(l,r) scale
+                target = max(lt.scale, rt.scale)
+                num = CallBinary("mul", l, Literal(10 ** (target + rt.scale - lt.scale)))
+                return CallBinary("div", num, r), PType(ColType.NUMERIC, target)
+            return CallBinary("div", l, r), INT
+        if op == "%":
+            return CallBinary("mod", l, r), INT
+        raise PlanError(f"binary op {op}")
+
+    def _arith_type(self, lt: PType, rt: PType) -> PType:
+        if ColType.FLOAT64 in (lt.col, rt.col):
+            return FLOAT
+        if ColType.NUMERIC in (lt.col, rt.col):
+            return PType(ColType.NUMERIC, max(lt.scale, rt.scale))
+        return INT
+
+    def _align(self, l, lt: PType, r, rt: PType):
+        """Align numeric scales for add/sub/compare."""
+        t = self._arith_type(lt, rt)
+        if t.col == ColType.NUMERIC:
+            target = max(lt.scale, rt.scale)
+            l = _rescale(l, lt.scale, target)
+            r = _rescale(r, rt.scale, target)
+            return l, r, PType(ColType.NUMERIC, target)
+        if t.col == ColType.FLOAT64:
+            return _to_float(l, lt), _to_float(r, rt), FLOAT
+        return l, r, t
+
+    def _plan_case(self, e: ast.Case, scope: Scope):
+        whens = e.whens
+        if e.operand is not None:
+            whens = tuple(
+                (ast.BinaryOp("=", e.operand, cond), res) for cond, res in whens
+            )
+        else_, et = (
+            self.plan_scalar(e.else_, scope) if e.else_ is not None else (Literal(0), INT)
+        )
+        result = else_
+        rt = et
+        for cond, res in reversed(whens):
+            c, _ = self.plan_scalar(cond, scope)
+            v, vt = self.plan_scalar(res, scope)
+            v, result, rt = self._align(v, vt, result, rt)
+            result = CallVariadic("if", (c, v, result))
+        return result, rt
+
+    def _plan_cast(self, e: ast.Cast, scope: Scope):
+        from ..adapter.catalog import coltype_of
+
+        v, vt = self.plan_scalar(e.expr, scope)
+        target = coltype_of(e.typ)
+        if target == ColType.NUMERIC:
+            scale = 2
+            if vt.col == ColType.NUMERIC:
+                return _rescale(v, vt.scale, scale), PType(ColType.NUMERIC, scale)
+            return CallBinary("mul", CallUnary("cast_int64", v), Literal(10**scale)), PType(
+                ColType.NUMERIC, scale
+            )
+        if target in (ColType.INT64, ColType.INT32):
+            if vt.col == ColType.NUMERIC:
+                return _rescale(v, vt.scale, 0), INT
+            return CallUnary("cast_int64", v), INT
+        if target == ColType.FLOAT64:
+            return CallUnary("cast_float", _descale(v, vt)), FLOAT
+        if target == ColType.BOOL:
+            return CallUnary("is_true", v), BOOL
+        raise PlanError(f"unsupported cast to {e.typ}")
+
+    def _plan_func(self, e: ast.FuncCall, scope: Scope):
+        name = e.name
+        if name in _AGG_FUNCS:
+            raise PlanError(f"aggregate {name} not allowed in this context")
+        if name == "abs":
+            v, t = self.plan_scalar(e.args[0], scope)
+            return CallUnary("abs", v), t
+        if name in ("greatest", "least"):
+            planned = [self.plan_scalar(a, scope) for a in e.args]
+            t = planned[0][1]
+            return CallVariadic(name, tuple(p for p, _ in planned)), t
+        raise PlanError(f"unsupported function: {name}")
+
+    # -- relation planning ---------------------------------------------------
+    def plan_query(self, q: ast.Query) -> PlannedQuery:
+        rel, scope = self.plan_set_expr(q.body)
+        order, limit, offset = q.order_by, q.limit, q.offset
+        order_idx = []
+        for ob in order:
+            idx = self._resolve_output_col(ob.expr, q.body, scope)
+            order_idx.append((idx, ob.desc))
+        finishing = RowSetFinishing(tuple(order_idx), limit, offset)
+        return PlannedQuery(rel, scope, finishing)
+
+    def _resolve_output_col(self, e, body, scope: Scope) -> int:
+        if isinstance(e, ast.NumberLit) and "." not in e.value:
+            n = int(e.value)
+            if not (1 <= n <= len(scope.cols)):
+                raise PlanError(f"ORDER BY position {n} out of range")
+            return n - 1
+        if isinstance(e, ast.Ident) and e.qualifier is None:
+            for i, c in enumerate(scope.cols):
+                if c.name == e.name:
+                    return i
+        raise PlanError(f"cannot resolve ORDER BY expression {e!r}")
+
+    def plan_set_expr(self, body):
+        if isinstance(body, ast.Select):
+            return self.plan_select(body)
+        if isinstance(body, ast.SetOp):
+            lrel, lscope = self.plan_set_expr(body.left)
+            rrel, rscope = self.plan_set_expr(body.right)
+            if len(lscope.cols) != len(rscope.cols):
+                raise PlanError("set operands have different arities")
+            op = body.op
+            if op == "union_all":
+                return mir.MirUnion((lrel, rrel)), lscope
+            if op == "union":
+                return mir.MirDistinct(mir.MirUnion((lrel, rrel))), lscope
+            if op in ("except", "except_all"):
+                if op == "except":
+                    lrel, rrel = mir.MirDistinct(lrel), mir.MirDistinct(rrel)
+                return (
+                    mir.MirThreshold(mir.MirUnion((lrel, mir.MirNegate(rrel)))),
+                    lscope,
+                )
+            if op in ("intersect", "intersect_all"):
+                if op == "intersect":
+                    lrel, rrel = mir.MirDistinct(lrel), mir.MirDistinct(rrel)
+                # min(a,b) = a - (a - b)^+
+                diff = mir.MirThreshold(mir.MirUnion((lrel, mir.MirNegate(rrel))))
+                return (
+                    mir.MirThreshold(mir.MirUnion((lrel, mir.MirNegate(diff)))),
+                    lscope,
+                )
+            raise PlanError(f"set op {op}")
+        if isinstance(body, ast.Query):
+            pq = self.plan_query(body)
+            if pq.finishing.limit is not None or pq.finishing.order_by:
+                rel = _apply_finishing_as_topk(pq)
+            else:
+                rel = pq.mir
+            return rel, pq.scope
+        raise PlanError(f"unsupported query body {type(body).__name__}")
+
+    def plan_select(self, sel: ast.Select):
+        # 1. FROM: flatten factors + inner joins into one MirJoin
+        factors: list = []
+        scopes: list[Scope] = []
+        on_preds: list = []
+        if not sel.from_:
+            factors.append(mir.MirConstant(rows=(((), 1),), dtypes=()))
+            scopes.append(Scope([]))
+        for f in sel.from_:
+            self._flatten_from(f, factors, scopes, on_preds)
+        full_scope = Scope([c for s in scopes for c in s.cols])
+        offsets = []
+        off = 0
+        for s in scopes:
+            offsets.append(off)
+            off += len(s.cols)
+
+        # 2. conjuncts from ON + WHERE; split equijoin equivalences vs filters
+        conjuncts = []
+        for p in on_preds:
+            conjuncts.extend(_split_and(p))
+        if sel.where is not None:
+            conjuncts.extend(_split_and(sel.where))
+        equivs: list[set] = []
+        residual = []
+        for c in conjuncts:
+            pair = self._as_column_equality(c, full_scope, scopes, offsets)
+            if pair is not None:
+                merged = False
+                for cls in equivs:
+                    if pair[0] in cls or pair[1] in cls:
+                        cls.update(pair)
+                        merged = True
+                        break
+                if not merged:
+                    equivs.append(set(pair))
+            else:
+                residual.append(c)
+        if len(factors) == 1:
+            rel = factors[0]
+        else:
+            rel = mir.MirJoin(
+                inputs=tuple(factors),
+                equivalences=tuple(tuple(sorted(c)) for c in equivs),
+            )
+        scope = full_scope
+        for c in residual:
+            p, _t = self.plan_scalar(c, scope)
+            rel = mir.MirFilter(rel, (p,))
+
+        # 3. aggregates?
+        has_group = bool(sel.group_by)
+        aggs: list[ast.FuncCall] = []
+        items = [
+            ast.SelectItem(self._extract_aggs(it.expr, aggs), it.alias)
+            for it in sel.items
+        ]
+        having = self._extract_aggs(sel.having, aggs) if sel.having is not None else None
+        if has_group or aggs:
+            rel, scope, items, having = self._plan_reduce(
+                rel, scope, sel, items, aggs, having
+            )
+        if having is not None:
+            p, _ = self.plan_scalar(having, scope)
+            rel = mir.MirFilter(rel, (p,))
+
+        # 4. projection (names come from the pre-rewrite select items)
+        out_exprs = []
+        out_cols = []
+        for it, orig in zip(items, sel.items):
+            if isinstance(it.expr, ast.Star):
+                for i, c in enumerate(scope.cols):
+                    if it.expr.qualifier is None or c.qualifier == it.expr.qualifier:
+                        out_exprs.append((Column(i), c.typ))
+                        out_cols.append(ScopeCol(c.qualifier, c.name, c.typ))
+            else:
+                p, t = self.plan_scalar(it.expr, scope)
+                out_exprs.append((p, t))
+                name = orig.alias or _default_name(orig.expr)
+                out_cols.append(ScopeCol(None, name, t))
+        arity_in = len(scope.cols)
+        rel = mir.MirMap(rel, tuple(p for p, _ in out_exprs))
+        rel = mir.MirProject(rel, tuple(range(arity_in, arity_in + len(out_exprs))))
+        out_scope = Scope(out_cols)
+        if sel.distinct:
+            rel = mir.MirDistinct(rel)
+        return rel, out_scope
+
+    def _flatten_from(self, f, factors, scopes, on_preds):
+        if isinstance(f, ast.TableRef):
+            item = self.catalog.get(f.name)
+            if item.desc is None:
+                raise PlanError(f"{f.name} has no relation description")
+            alias = f.alias or f.name
+            if item.kind == "view":
+                # inline the stored view MIR (the reference inlines view
+                # definitions during name resolution too)
+                pq = item.mir
+                rel = pq.mir
+                if pq.finishing.limit is not None:
+                    rel = _apply_finishing_as_topk(pq)
+                factors.append(rel)
+                scopes.append(
+                    Scope([ScopeCol(alias, c.name, c.typ) for c in pq.scope.cols])
+                )
+                return
+            factors.append(mir.MirGet(item.global_id, item.desc.arity))
+            scopes.append(
+                Scope(
+                    [
+                        ScopeCol(alias, c.name, PType(c.typ, c.scale if c.typ == ColType.NUMERIC else 0))
+                        for c in item.desc.columns
+                    ]
+                )
+            )
+            return
+        if isinstance(f, ast.SubqueryRef):
+            pq = self.plan_query(f.query)
+            rel = pq.mir
+            if pq.finishing.limit is not None:
+                rel = _apply_finishing_as_topk(pq)
+            factors.append(rel)
+            scopes.append(
+                Scope([ScopeCol(f.alias, c.name, c.typ) for c in pq.scope.cols])
+            )
+            return
+        if isinstance(f, ast.JoinClause):
+            if f.kind == "cross":
+                self._flatten_from(f.left, factors, scopes, on_preds)
+                self._flatten_from(f.right, factors, scopes, on_preds)
+                return
+            if f.kind != "inner":
+                raise PlanError(f"{f.kind} outer joins not supported yet")
+            self._flatten_from(f.left, factors, scopes, on_preds)
+            self._flatten_from(f.right, factors, scopes, on_preds)
+            if f.on is not None:
+                on_preds.append(f.on)
+            return
+        raise PlanError(f"unsupported FROM clause {type(f).__name__}")
+
+    def _as_column_equality(self, c, full_scope, scopes, offsets):
+        """col = col crossing two inputs → (global_col_a, global_col_b)."""
+        if not (isinstance(c, ast.BinaryOp) and c.op == "="):
+            return None
+        l, r = c.left, c.right
+        if not (isinstance(l, ast.Ident) and isinstance(r, ast.Ident)):
+            return None
+        try:
+            li = full_scope.resolve(l.name, l.qualifier)
+            ri = full_scope.resolve(r.name, r.qualifier)
+        except PlanError:
+            return None
+        # find owning inputs
+        def owner(i):
+            for k in range(len(offsets) - 1, -1, -1):
+                if i >= offsets[k]:
+                    return k
+            return 0
+
+        if owner(li) == owner(ri):
+            return None
+        return (li, ri)
+
+    def _extract_aggs(self, e, aggs: list):
+        """Replace aggregate FuncCalls with _AggRef placeholders."""
+        if e is None or isinstance(e, (ast.NumberLit, ast.StringLit, ast.BoolLit, ast.NullLit, ast.DateLit, ast.Ident, ast.Star)):
+            return e
+        if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS:
+            for i, a in enumerate(aggs):
+                if a == e:
+                    return _AggRef(i)
+            aggs.append(e)
+            return _AggRef(len(aggs) - 1)
+        if isinstance(e, ast.UnaryOp):
+            return replace(e, expr=self._extract_aggs(e.expr, aggs))
+        if isinstance(e, ast.BinaryOp):
+            return replace(
+                e,
+                left=self._extract_aggs(e.left, aggs),
+                right=self._extract_aggs(e.right, aggs),
+            )
+        if isinstance(e, ast.FuncCall):
+            return replace(e, args=tuple(self._extract_aggs(a, aggs) for a in e.args))
+        if isinstance(e, ast.Cast):
+            return replace(e, expr=self._extract_aggs(e.expr, aggs))
+        if isinstance(e, ast.Case):
+            return ast.Case(
+                self._extract_aggs(e.operand, aggs) if e.operand else None,
+                tuple(
+                    (self._extract_aggs(c, aggs), self._extract_aggs(r, aggs))
+                    for c, r in e.whens
+                ),
+                self._extract_aggs(e.else_, aggs) if e.else_ else None,
+            )
+        if isinstance(e, ast.Between):
+            return replace(
+                e,
+                expr=self._extract_aggs(e.expr, aggs),
+                low=self._extract_aggs(e.low, aggs),
+                high=self._extract_aggs(e.high, aggs),
+            )
+        if isinstance(e, ast.InList):
+            return replace(
+                e,
+                expr=self._extract_aggs(e.expr, aggs),
+                items=tuple(self._extract_aggs(i, aggs) for i in e.items),
+            )
+        if isinstance(e, ast.IsNull):
+            return replace(e, expr=self._extract_aggs(e.expr, aggs))
+        return e
+
+    def _plan_reduce(self, rel, scope, sel, items, aggs, having):
+        """GROUP BY planning: Map(keys+agg args) → Reduce → post scope."""
+        # resolve group-by items (ordinals refer to select items pre-extraction)
+        group_asts = []
+        for g in sel.group_by:
+            if isinstance(g, ast.NumberLit) and "." not in g.value:
+                n = int(g.value)
+                if not (1 <= n <= len(sel.items)):
+                    raise PlanError(f"GROUP BY position {n} out of range")
+                group_asts.append(sel.items[n - 1].expr)
+            else:
+                group_asts.append(g)
+        key_planned = [self.plan_scalar(g, scope) for g in group_asts]
+
+        # plan aggregate argument expressions + build MirAggregates
+        mir_aggs = []
+        agg_types = []
+        post_agg_exprs: list = []  # how each _AggRef is reconstructed post-reduce
+        for a in aggs:
+            fname = a.name
+            if a.distinct:
+                raise PlanError("DISTINCT aggregates not supported yet")
+            if fname == "count":
+                arg = Literal(1)
+                at = INT
+                mir_aggs.append(mir.MirAggregate("count", arg))
+                post_agg_exprs.append(("col", len(mir_aggs) - 1, INT))
+                agg_types.append(INT)
+            elif fname == "avg":
+                v, vt = self.plan_scalar(a.args[0], scope)
+                mir_aggs.append(mir.MirAggregate("sum", v))
+                sum_i = len(mir_aggs) - 1
+                mir_aggs.append(mir.MirAggregate("count", Literal(1)))
+                cnt_i = len(mir_aggs) - 1
+                post_agg_exprs.append(("avg", (sum_i, cnt_i, vt), FLOAT))
+                agg_types.extend([vt, INT])
+            else:
+                v, vt = self.plan_scalar(a.args[0], scope)
+                out_t = vt if fname != "count" else INT
+                mir_aggs.append(mir.MirAggregate(fname, v))
+                post_agg_exprs.append(("col", len(mir_aggs) - 1, out_t))
+                agg_types.append(out_t)
+
+        # keys become mapped columns so the Reduce's group_key is plain columns
+        arity_in = len(scope.cols)
+        key_exprs = tuple(p for p, _ in key_planned)
+        inner = mir.MirMap(rel, key_exprs)
+        rel = mir.MirReduce(
+            inner,
+            group_key=tuple(range(arity_in, arity_in + len(key_exprs))),
+            aggregates=tuple(mir_aggs),
+        )
+
+        # post-reduce scope: keys then aggregate outputs
+        post_cols = []
+        for gast, (_, t) in zip(group_asts, key_planned):
+            name = gast.name if isinstance(gast, ast.Ident) else _default_name(gast)
+            qual = gast.qualifier if isinstance(gast, ast.Ident) else None
+            post_cols.append(ScopeCol(qual, name, t))
+        nkeys = len(post_cols)
+        for ag, t in zip(mir_aggs, agg_types):
+            post_cols.append(ScopeCol(None, None, t))
+        post_scope = Scope(post_cols)
+
+        # rewrite items/having: _AggRef(i) → column ref; group asts → key cols
+        self._group_asts = group_asts
+        self._post_nkeys = nkeys
+        self._post_agg_exprs = post_agg_exprs
+
+        items = [
+            ast.SelectItem(self._rewrite_post(it.expr), it.alias) for it in items
+        ]
+        having = self._rewrite_post(having) if having is not None else None
+        return rel, post_scope, items, having
+
+    def _rewrite_post(self, e):
+        """Rewrite a post-aggregation AST: group exprs → _PostCol, aggs → _PostCol/avg."""
+        if e is None:
+            return None
+        for k, g in enumerate(self._group_asts):
+            if e == g:
+                return _PostCol(k)
+        if isinstance(e, _AggRef):
+            kind, payload, t = self._post_agg_exprs[e.index]
+            if kind == "col":
+                return _PostCol(self._post_nkeys + payload)
+            sum_i, cnt_i, vt = payload
+            return _PostAvg(self._post_nkeys + sum_i, self._post_nkeys + cnt_i, vt)
+        if isinstance(e, ast.UnaryOp):
+            return replace(e, expr=self._rewrite_post(e.expr))
+        if isinstance(e, ast.BinaryOp):
+            return replace(e, left=self._rewrite_post(e.left), right=self._rewrite_post(e.right))
+        if isinstance(e, ast.FuncCall):
+            return replace(e, args=tuple(self._rewrite_post(a) for a in e.args))
+        if isinstance(e, ast.Cast):
+            return replace(e, expr=self._rewrite_post(e.expr))
+        if isinstance(e, ast.Ident):
+            raise PlanError(
+                f"column {e.name} must appear in GROUP BY or be used in an aggregate"
+            )
+        return e
+
+
+@dataclass(frozen=True)
+class _PostCol:
+    index: int
+
+
+@dataclass(frozen=True)
+class _PostAvg:
+    sum_col: int
+    cnt_col: int
+    vt: PType
+
+
+def _to_float(e, t: PType):
+    """Cast to float, descaling NUMERIC fixed-point by its scale factor."""
+    f = CallUnary("cast_float", e)
+    if t.col == ColType.NUMERIC and t.scale:
+        f = CallBinary("div", f, Literal(float(10**t.scale), "float32"))
+    return f
+
+
+def _split_and(e):
+    if isinstance(e, ast.BinaryOp) and e.op == "and":
+        return _split_and(e.left) + _split_and(e.right)
+    return [e]
+
+
+def _default_name(e) -> str:
+    if isinstance(e, ast.Ident):
+        return e.name
+    if isinstance(e, ast.FuncCall):
+        return e.name
+    if isinstance(e, _AggRef):
+        return "agg"
+    return "column"
+
+
+def _apply_finishing_as_topk(pq: PlannedQuery):
+    """LIMIT inside a view body becomes a TopK (global group)."""
+    return mir.MirTopK(
+        pq.mir,
+        group_key=(),
+        order_by=tuple(pq.finishing.order_by),
+        limit=pq.finishing.limit,
+        offset=pq.finishing.offset,
+    )

@@ -1,0 +1,3 @@
+from .generator import AuctionGenerator, TpchGenerator, date_num
+
+__all__ = ["AuctionGenerator", "TpchGenerator", "date_num"]

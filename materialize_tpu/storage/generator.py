@@ -1,0 +1,237 @@
+"""Deterministic load-generator sources (auction, TPC-H).
+
+The TPU build's stand-in for the reference's load-generator sources
+(src/storage-types/src/sources/load_generator.rs:146-240 — Auction tables
+organizations/users/accounts/auctions/bids; Tpch with per-table row counts):
+deterministic input without Kafka, for tests and benchmarks. Generation is
+vectorized NumPy on host; batches land on device as UpdateBatch columns.
+
+Schemas follow the reference:
+  auctions(id i64, seller i64, item str, end_time ts)
+  bids(id i64, buyer i64, auction_id i64, amount i32→i64, bid_time ts)
+TPC-H columns are the Q3/Q17-demanded subset, with NUMERIC money columns as
+fixed-point i64 cents and dates as i32 day numbers (TPU-native choices: exact
+arithmetic without f64).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..repr.batch import UpdateBatch
+from ..repr.types import StringDictionary
+
+_ITEMS = [
+    "Signed Memorabilia",
+    "City Bar Crawl",
+    "Best Pizza in Town",
+    "Gift Basket",
+    "Custom Art",
+]
+
+
+class AuctionGenerator:
+    """Append-only auction/bids stream, deterministic per seed.
+
+    Mirrors the reference auction generator's shape (load_generator.rs:185-240):
+    static organizations/users/accounts; a stream of auctions and bids.
+    """
+
+    def __init__(self, seed: int = 0, n_auctions_per_tick: int = 4, dict_: StringDictionary | None = None):
+        self.rng = np.random.default_rng(seed)
+        self.dict = dict_ or StringDictionary()
+        self.item_codes = self.dict.encode_many(_ITEMS)
+        self.next_auction_id = 0
+        self.next_bid_id = 0
+        self.n_auctions_per_tick = n_auctions_per_tick
+        self.open_auctions: np.ndarray = np.array([], dtype=np.int64)
+
+    def static_tables(self) -> dict[str, tuple]:
+        orgs = np.arange(20, dtype=np.int64)
+        org_names = self.dict.encode_many([f"org #{i}" for i in orgs])
+        users = np.arange(1000, dtype=np.int64)
+        user_org = users % 20
+        user_names = self.dict.encode_many([f"user #{i}" for i in users])
+        balances = np.full(1000, 10_000, dtype=np.int64)
+        return {
+            "organizations": (orgs, org_names),
+            "users": (users, user_org, user_names),
+            "accounts": (users, user_org, balances),
+        }
+
+    def next_tick(self, tick: int, n_bids: int) -> dict[str, UpdateBatch]:
+        """New auctions + a batch of bids on open auctions at time `tick`."""
+        na = self.n_auctions_per_tick
+        a_ids = np.arange(self.next_auction_id, self.next_auction_id + na, dtype=np.int64)
+        self.next_auction_id += na
+        sellers = self.rng.integers(0, 1000, na).astype(np.int64)
+        items = self.item_codes[self.rng.integers(0, len(self.item_codes), na)]
+        end_times = np.full(na, tick + 100, dtype=np.int64)
+        self.open_auctions = np.concatenate([self.open_auctions, a_ids])
+
+        b_ids = np.arange(self.next_bid_id, self.next_bid_id + n_bids, dtype=np.int64)
+        self.next_bid_id += n_bids
+        buyers = self.rng.integers(0, 1000, n_bids).astype(np.int64)
+        target = self.open_auctions[
+            self.rng.integers(0, len(self.open_auctions), n_bids)
+        ]
+        amounts = self.rng.integers(1, 10_000, n_bids).astype(np.int64)
+        bid_times = np.full(n_bids, tick, dtype=np.int64)
+
+        return {
+            "auctions": UpdateBatch.build(
+                (), (a_ids, sellers, items, end_times), [tick] * na, [1] * na
+            ),
+            "bids": UpdateBatch.build(
+                (),
+                (b_ids, buyers, target, amounts, bid_times),
+                [tick] * n_bids,
+                [1] * n_bids,
+            ),
+        }
+
+
+def date_num(y: int, m: int, d: int) -> int:
+    """Days since 1992-01-01 (TPC-H epoch)."""
+    return (np.datetime64(f"{y:04d}-{m:02d}-{d:02d}") - np.datetime64("1992-01-01")).astype(int)
+
+
+_SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+
+
+@dataclass
+class TpchTables:
+    customer: tuple  # (custkey, mktsegment_code, nationkey)
+    orders: tuple  # (orderkey, custkey, orderdate, shippriority)
+    lineitem: tuple  # (orderkey, extendedprice_cents, discount_pct, shipdate, quantity, partkey)
+    part: tuple  # (partkey, brand_code, container_code)
+
+
+class TpchGenerator:
+    """TPC-H -flavored deterministic generator with RF1/RF2 refresh streams.
+
+    Row counts follow the reference Tpch load generator's knobs
+    (load_generator.rs:157: count_customer/count_orders/...); per TPC-H spec,
+    customer = 150k·SF, orders = 1.5M·SF, lineitems 1–7 per order. Money is
+    fixed-point i64 cents; dates are day numbers (date_num).
+    """
+
+    def __init__(self, sf: float = 0.01, seed: int = 0):
+        self.sf = sf
+        self.rng = np.random.default_rng(seed)
+        self.n_customer = max(int(150_000 * sf), 10)
+        self.n_orders = max(int(1_500_000 * sf), 20)
+        self.n_part = max(int(200_000 * sf), 10)
+        self.next_orderkey = self.n_orders
+        # host mirrors of live orders/lineitems so RF2 can emit exact
+        # retractions (column tuples, appended by RF1, consumed from the front)
+        self._orders_store: list | None = None
+        self._lineitem_store: list | None = None
+
+    def initial(self) -> TpchTables:
+        rng = np.random.default_rng(12345)
+        custkey = np.arange(self.n_customer, dtype=np.int64)
+        mktsegment = rng.integers(0, 5, self.n_customer).astype(np.int64)
+        nationkey = rng.integers(0, 25, self.n_customer).astype(np.int64)
+
+        orderkey = np.arange(self.n_orders, dtype=np.int64)
+        o_custkey = rng.integers(0, self.n_customer, self.n_orders).astype(np.int64)
+        o_orderdate = rng.integers(0, 2406, self.n_orders).astype(np.int64)  # 1992-1998
+        o_shippriority = np.zeros(self.n_orders, dtype=np.int64)
+
+        nli = rng.integers(1, 8, self.n_orders)
+        l_orderkey = np.repeat(orderkey, nli)
+        n_l = len(l_orderkey)
+        l_extendedprice = rng.integers(100_00, 100_000_00, n_l).astype(np.int64)
+        l_discount = rng.integers(0, 11, n_l).astype(np.int64)  # percent
+        l_shipdate = rng.integers(0, 2557, n_l).astype(np.int64)
+        l_quantity = rng.integers(1, 51, n_l).astype(np.int64)
+        l_partkey = rng.integers(0, self.n_part, n_l).astype(np.int64)
+
+        partkey = np.arange(self.n_part, dtype=np.int64)
+        p_brand = rng.integers(0, 25, self.n_part).astype(np.int64)
+        p_container = rng.integers(0, 40, self.n_part).astype(np.int64)
+
+        self._customer = (custkey, mktsegment, nationkey)
+        self._orders_store = [np.asarray(c) for c in (orderkey, o_custkey, o_orderdate, o_shippriority)]
+        self._lineitem_store = [
+            np.asarray(c)
+            for c in (l_orderkey, l_extendedprice, l_discount, l_shipdate, l_quantity, l_partkey)
+        ]
+        return TpchTables(
+            customer=(custkey, mktsegment, nationkey),
+            orders=(orderkey, o_custkey, o_orderdate, o_shippriority),
+            lineitem=(l_orderkey, l_extendedprice, l_discount, l_shipdate, l_quantity, l_partkey),
+            part=(partkey, p_brand, p_container),
+        )
+
+    def _customer_cols(self) -> tuple:
+        return self._customer
+
+    def initial_batches(self, tick: int = 0) -> dict[str, UpdateBatch]:
+        t = self.initial()
+        out = {}
+        for name in ("customer", "orders", "lineitem", "part"):
+            cols = getattr(t, name)
+            n = len(cols[0])
+            out[name] = UpdateBatch.build((), cols, np.full(n, tick), np.ones(n, dtype=np.int64))
+        return out
+
+    def refresh(self, tick: int, frac: float = 0.001, deletes: bool = True) -> dict[str, UpdateBatch]:
+        """RF1 (insert new orders+lineitems) + RF2 (delete the oldest ones),
+        the TPC-H refresh functions — the canonical IVM update stream."""
+        assert self._orders_store is not None, "call initial()/initial_batches() first"
+        n_new = max(int(self.n_orders * frac), 1)
+        rng = self.rng
+        new_ok = np.arange(self.next_orderkey, self.next_orderkey + n_new, dtype=np.int64)
+        self.next_orderkey += n_new
+        o_cols = (
+            new_ok,
+            rng.integers(0, self.n_customer, n_new).astype(np.int64),
+            rng.integers(0, 2406, n_new).astype(np.int64),
+            np.zeros(n_new, dtype=np.int64),
+        )
+        nli = rng.integers(1, 8, n_new)
+        lk = np.repeat(new_ok, nli)
+        n_l = len(lk)
+        l_cols = (
+            lk,
+            rng.integers(100_00, 100_000_00, n_l).astype(np.int64),
+            rng.integers(0, 11, n_l).astype(np.int64),
+            rng.integers(0, 2557, n_l).astype(np.int64),
+            rng.integers(1, 51, n_l).astype(np.int64),
+            rng.integers(0, self.n_part, n_l).astype(np.int64),
+        )
+
+        o_out = [o_cols]
+        l_out = [l_cols]
+        o_diffs = [np.ones(n_new, dtype=np.int64)]
+        l_diffs = [np.ones(n_l, dtype=np.int64)]
+        if deletes:
+            # RF2: retract the n_new oldest live orders and their lineitems
+            del_ok = self._orders_store[0][:n_new]
+            o_out.append(tuple(c[:n_new] for c in self._orders_store))
+            o_diffs.append(-np.ones(len(del_ok), dtype=np.int64))
+            mask = np.isin(self._lineitem_store[0], del_ok)
+            o_del_l = tuple(c[mask] for c in self._lineitem_store)
+            l_out.append(o_del_l)
+            l_diffs.append(-np.ones(len(o_del_l[0]), dtype=np.int64))
+            self._orders_store = [c[n_new:] for c in self._orders_store]
+            self._lineitem_store = [c[~mask] for c in self._lineitem_store]
+        self._orders_store = [
+            np.concatenate([a, b]) for a, b in zip(self._orders_store, o_cols)
+        ]
+        self._lineitem_store = [
+            np.concatenate([a, b]) for a, b in zip(self._lineitem_store, l_cols)
+        ]
+
+        o_all = tuple(np.concatenate([p[i] for p in o_out]) for i in range(4))
+        l_all = tuple(np.concatenate([p[i] for p in l_out]) for i in range(6))
+        od = np.concatenate(o_diffs)
+        ld = np.concatenate(l_diffs)
+        return {
+            "orders": UpdateBatch.build((), o_all, np.full(len(od), tick), od),
+            "lineitem": UpdateBatch.build((), l_all, np.full(len(ld), tick), ld),
+        }

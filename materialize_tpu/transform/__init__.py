@@ -1,0 +1,4 @@
+from .optimize import optimize
+from .join_implementation import plan_join_implementation
+
+__all__ = ["optimize", "plan_join_implementation"]

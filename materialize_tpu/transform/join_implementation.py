@@ -1,0 +1,148 @@
+"""Join implementation planning: linear chain vs delta paths + stage keys.
+
+The analogue of the reference's `JoinImplementation` transform
+(src/transform/src/join_implementation.rs): given an N-way MirJoin with
+equivalence classes over the flat column space, pick
+
+- **linear** (binary chain arranging intermediates — differential
+  `join_core`, linear_join.rs) for 2 inputs, or
+- **delta** (one update path per input, no intermediate arrangements —
+  delta_join.rs) for 3+ inputs,
+
+and derive per-stage stream/lookup keys by walking the equivalence graph in
+input order. Equality members not consumed as lookup keys are re-asserted as
+residual closure predicates (correct even when classes span 3+ columns).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..dataflow import plan as lir
+from ..expr import relation as mir
+
+
+@dataclass(frozen=True)
+class JoinPlanned:
+    """Physical join choice attached to MirJoin.implementation."""
+
+    kind: str  # "linear" | "delta"
+    lir_plan: object  # lir.LinearJoinPlan | lir.DeltaJoinPlan
+    input_order: tuple  # for linear: order in which inputs are chained
+    residual_equalities: tuple  # ((global_col_a, global_col_b), ...)
+
+
+def _offsets(arities):
+    out, off = [], 0
+    for a in arities:
+        out.append(off)
+        off += a
+    return out
+
+
+def plan_join_implementation(join: mir.MirJoin) -> JoinPlanned:
+    arities = [mir.arity(i) for i in join.inputs]
+    offsets = _offsets(arities)
+    n = len(join.inputs)
+
+    def owner(gcol: int) -> int:
+        for k in range(n - 1, -1, -1):
+            if gcol >= offsets[k]:
+                return k
+        return 0
+
+    def local(gcol: int) -> int:
+        return gcol - offsets[owner(gcol)]
+
+    # equivalence classes as {input: [local cols]}
+    classes = []
+    for cls in join.equivalences:
+        bymem: dict[int, list[int]] = {}
+        for g in cls:
+            bymem.setdefault(owner(g), []).append(local(g))
+        classes.append((cls, bymem))
+
+    def stage_keys(done: set[int], nxt: int, stream_cols: list):
+        """Keys joining `nxt` to the accumulated inputs in `done`.
+
+        stream_cols: list of (input, local) in current stream order.
+        Returns (stream_key, lookup_key, used_class_idxs).
+        """
+        skey, lkey, used = [], [], []
+        for ci, (_cls, bymem) in enumerate(classes):
+            if nxt not in bymem:
+                continue
+            stream_side = None
+            for inp in done:
+                if inp in bymem:
+                    stream_side = (inp, bymem[inp][0])
+                    break
+            if stream_side is None:
+                continue
+            skey.append(stream_cols.index(stream_side))
+            lkey.append(bymem[nxt][0])
+            used.append(ci)
+        return tuple(skey), tuple(lkey), used
+
+    def next_input(done: set[int]) -> int:
+        # prefer an input connected to what's done; fall back to input order
+        for k in range(n):
+            if k in done:
+                continue
+            for _cls, bymem in classes:
+                if k in bymem and any(d in bymem for d in done):
+                    return k
+        for k in range(n):
+            if k not in done:
+                return k
+        raise AssertionError("no next input")
+
+    residuals = []
+    for cls, bymem in classes:
+        members = sorted(cls)
+        for m in members[1:]:
+            residuals.append((members[0], m))
+    # residuals re-assert full class equality; the used lookup keys make most
+    # of them tautological, which the closure MFP evaluates cheaply.
+
+    if n == 2:
+        done = {0}
+        stream_cols = [(0, j) for j in range(arities[0])]
+        skey, lkey, _ = stage_keys(done, 1, stream_cols)
+        plan = lir.LinearJoinPlan(stages=(lir.JoinStage(skey, lkey),))
+        return JoinPlanned("linear", plan, (0, 1), tuple(residuals))
+
+    if n > 6:
+        # very wide joins: chain linearly in input order (delta paths grow
+        # O(n^2) lookups; reference caps delta breadth similarly and has
+        # tested 64-relation linear chains, README.md:46)
+        stages = []
+        done = {0}
+        stream_cols = [(0, j) for j in range(arities[0])]
+        for nxt in range(1, n):
+            skey, lkey, _ = stage_keys(done, nxt, stream_cols)
+            stages.append(lir.JoinStage(skey, lkey))
+            stream_cols += [(nxt, j) for j in range(arities[nxt])]
+            done.add(nxt)
+        plan = lir.LinearJoinPlan(stages=tuple(stages))
+        return JoinPlanned("linear", plan, tuple(range(n)), tuple(residuals))
+
+    # delta join: one path per input
+    paths, perms = [], []
+    canonical = [(k, j) for k in range(n) for j in range(arities[k])]
+    for k in range(n):
+        done = {k}
+        stream_cols = [(k, j) for j in range(arities[k])]
+        path = []
+        for _ in range(n - 1):
+            nxt = next_input(done)
+            skey, lkey, _ = stage_keys(done, nxt, stream_cols)
+            path.append(
+                lir.DeltaPathStage(other_input=nxt, stream_key=skey, lookup_key=lkey)
+            )
+            stream_cols += [(nxt, j) for j in range(arities[nxt])]
+            done.add(nxt)
+        paths.append(tuple(path))
+        perms.append(tuple(stream_cols.index(c) for c in canonical))
+    plan = lir.DeltaJoinPlan(paths=tuple(paths), permutations=tuple(perms))
+    return JoinPlanned("delta", plan, tuple(range(n)), tuple(residuals))

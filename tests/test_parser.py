@@ -1,0 +1,118 @@
+"""SQL parser tests (pure host-side; no device ops)."""
+
+import pytest
+
+from materialize_tpu.sql import ast
+from materialize_tpu.sql.parser import ParseError, parse_statement, parse_statements
+
+
+def test_select_basic():
+    s = parse_statement("SELECT a, b + 1 AS c FROM t WHERE a > 2")
+    q = s.query
+    sel = q.body
+    assert len(sel.items) == 2
+    assert sel.items[1].alias == "c"
+    assert isinstance(sel.from_[0], ast.TableRef)
+    assert isinstance(sel.where, ast.BinaryOp)
+
+
+def test_select_join_group():
+    s = parse_statement(
+        """SELECT o.custkey, count(*), sum(l.price * (1 - l.disc))
+           FROM orders o JOIN lineitem l ON o.orderkey = l.orderkey
+           WHERE o.odate < DATE '1995-03-15'
+           GROUP BY o.custkey
+           ORDER BY 2 DESC LIMIT 10"""
+    )
+    q = s.query
+    assert q.limit == 10
+    assert q.order_by[0].desc
+    j = q.body.from_[0]
+    assert isinstance(j, ast.JoinClause) and j.kind == "inner"
+    assert q.body.group_by
+
+
+def test_operator_precedence():
+    s = parse_statement("SELECT 1 + 2 * 3 = 7 AND true OR false")
+    e = s.query.body.items[0].expr
+    assert isinstance(e, ast.BinaryOp) and e.op == "or"
+    assert e.left.op == "and"
+    cmp_ = e.left.left
+    assert cmp_.op == "="
+    assert cmp_.left.op == "+"
+    assert cmp_.left.right.op == "*"
+
+
+def test_create_statements():
+    s = parse_statement("CREATE TABLE t (a bigint NOT NULL, b text)")
+    assert isinstance(s, ast.CreateTable)
+    assert s.columns[0].not_null and s.columns[0].typ == "bigint"
+
+    s = parse_statement("CREATE SOURCE auction_house FROM LOAD GENERATOR AUCTION")
+    assert isinstance(s, ast.CreateSource) and s.generator == "auction"
+
+    s = parse_statement(
+        "CREATE SOURCE tp FROM LOAD GENERATOR TPCH (SCALE FACTOR 0.01)"
+    )
+    assert isinstance(s, ast.CreateSource) and s.generator == "tpch"
+
+    s = parse_statement("CREATE MATERIALIZED VIEW v AS SELECT a FROM t")
+    assert isinstance(s, ast.CreateMaterializedView)
+
+    s = parse_statement("CREATE INDEX i ON v (a, b)")
+    assert isinstance(s, ast.CreateIndex) and s.key_columns == ("a", "b")
+
+    s = parse_statement("CREATE DEFAULT INDEX ON v")
+    assert isinstance(s, ast.CreateIndex) and s.key_columns == ()
+
+
+def test_insert_delete():
+    s = parse_statement("INSERT INTO t (a, b) VALUES (1, 'x'), (2, 'y')")
+    assert isinstance(s, ast.Insert) and len(s.rows) == 2
+    s = parse_statement("DELETE FROM t WHERE a = 1")
+    assert isinstance(s, ast.Delete)
+
+
+def test_union_distinct_topk():
+    s = parse_statement(
+        "SELECT DISTINCT a FROM t UNION ALL SELECT b FROM u ORDER BY 1 LIMIT 5"
+    )
+    body = s.query.body
+    assert isinstance(body, ast.SetOp) and body.op == "union_all"
+    assert body.left.distinct
+
+
+def test_case_between_in():
+    s = parse_statement(
+        "SELECT CASE WHEN a BETWEEN 1 AND 5 THEN 'low' ELSE 'hi' END FROM t WHERE b IN (1,2,3)"
+    )
+    e = s.query.body.items[0].expr
+    assert isinstance(e, ast.Case)
+    assert isinstance(s.query.body.where, ast.InList)
+
+
+def test_script_multiple():
+    stmts = parse_statements("CREATE TABLE t (a int); INSERT INTO t VALUES (1); SELECT * FROM t;")
+    assert len(stmts) == 3
+
+
+def test_parse_error():
+    with pytest.raises(ParseError):
+        parse_statement("SELECT FROM WHERE")
+
+
+def test_q3_full_text():
+    s = parse_statement(
+        """SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS revenue,
+                  o_orderdate, o_shippriority
+           FROM customer, orders, lineitem
+           WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey
+             AND l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+             AND l_shipdate > DATE '1995-03-15'
+           GROUP BY l_orderkey, o_orderdate, o_shippriority
+           ORDER BY revenue DESC, o_orderdate LIMIT 10"""
+    )
+    q = s.query
+    assert len(q.body.from_) == 3
+    assert q.limit == 10
+    assert len(q.body.group_by) == 3

@@ -1,0 +1,161 @@
+"""End-to-end SQL: the full parse → plan → optimize → lower → render → peek
+stack through the Coordinator (the reference's life-of-a-query shape,
+doc/developer/life-of-a-query.md)."""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+
+
+@pytest.fixture
+def coord():
+    return Coordinator()
+
+
+def test_table_insert_select(coord):
+    coord.execute("CREATE TABLE t (a int, b int)")
+    coord.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    r = coord.execute("SELECT a, b FROM t WHERE a >= 2 ORDER BY a DESC")
+    assert r.rows == [(3, 30), (2, 20)]
+    assert r.columns == ("a", "b")
+
+
+def test_select_expressions(coord):
+    coord.execute("CREATE TABLE t (a int)")
+    coord.execute("INSERT INTO t VALUES (5)")
+    r = coord.execute("SELECT a * 2 + 1 AS x, a = 5, -a FROM t")
+    assert r.rows == [(11, True, -5)]
+
+
+def test_group_by_sum_count(coord):
+    coord.execute("CREATE TABLE bids (auction int, amount int)")
+    coord.execute("INSERT INTO bids VALUES (1, 10), (1, 5), (2, 7)")
+    r = coord.execute(
+        "SELECT auction, sum(amount), count(*) FROM bids GROUP BY auction ORDER BY auction"
+    )
+    assert r.rows == [(1, 15, 2), (2, 7, 1)]
+
+
+def test_materialized_view_incremental(coord):
+    coord.execute("CREATE TABLE bids (auction int, amount int)")
+    coord.execute("INSERT INTO bids VALUES (1, 10)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT auction, sum(amount) AS total FROM bids GROUP BY auction"
+    )
+    r = coord.execute("SELECT * FROM mv")
+    assert r.rows == [(1, 10)]
+    coord.execute("INSERT INTO bids VALUES (1, 5), (2, 3)")
+    r = coord.execute("SELECT * FROM mv ORDER BY auction")
+    assert r.rows == [(1, 15), (2, 3)]
+
+
+def test_join_sql(coord):
+    coord.execute("CREATE TABLE a (id int, x int)")
+    coord.execute("CREATE TABLE b (id int, y int)")
+    coord.execute("INSERT INTO a VALUES (1, 100), (2, 200)")
+    coord.execute("INSERT INTO b VALUES (1, 7), (1, 8), (3, 9)")
+    r = coord.execute(
+        "SELECT a.x, b.y FROM a JOIN b ON a.id = b.id ORDER BY y"
+    )
+    assert r.rows == [(100, 7), (100, 8)]
+
+
+def test_three_way_join_delta(coord):
+    coord.execute("CREATE TABLE r0 (a int, b int)")
+    coord.execute("CREATE TABLE r1 (b int, c int)")
+    coord.execute("CREATE TABLE r2 (c int, d int)")
+    coord.execute("INSERT INTO r0 VALUES (1, 5)")
+    coord.execute("INSERT INTO r1 VALUES (5, 8)")
+    coord.execute("INSERT INTO r2 VALUES (8, 99)")
+    # check the optimizer picked a delta join
+    r = coord.execute(
+        "EXPLAIN SELECT * FROM r0, r1, r2 WHERE r0.b = r1.b AND r1.c = r2.c"
+    )
+    plan_text = "\n".join(row[0] for row in r.rows)
+    assert "type=delta" in plan_text
+    r = coord.execute(
+        "SELECT r0.a, r2.d FROM r0, r1, r2 WHERE r0.b = r1.b AND r1.c = r2.c"
+    )
+    assert r.rows == [(1, 99)]
+
+
+def test_mv_on_mv_chain(coord):
+    coord.execute("CREATE TABLE t (g int, v int)")
+    coord.execute("INSERT INTO t VALUES (1, 2), (1, 3), (2, 4)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW m1 AS SELECT g, sum(v) AS s FROM t GROUP BY g"
+    )
+    coord.execute("CREATE MATERIALIZED VIEW m2 AS SELECT sum(s) AS total FROM m1")
+    assert coord.execute("SELECT * FROM m2").rows == [(9,)]
+    coord.execute("INSERT INTO t VALUES (3, 100)")
+    assert coord.execute("SELECT * FROM m2").rows == [(109,)]
+
+
+def test_distinct_union_except(coord):
+    coord.execute("CREATE TABLE t (a int)")
+    coord.execute("CREATE TABLE u (a int)")
+    coord.execute("INSERT INTO t VALUES (1), (1), (2)")
+    coord.execute("INSERT INTO u VALUES (2), (3)")
+    assert coord.execute("SELECT DISTINCT a FROM t ORDER BY a").rows == [(1,), (2,)]
+    assert coord.execute(
+        "SELECT a FROM t UNION SELECT a FROM u ORDER BY a"
+    ).rows == [(1,), (2,), (3,)]
+    assert coord.execute(
+        "SELECT a FROM t EXCEPT SELECT a FROM u ORDER BY a"
+    ).rows == [(1,)]
+
+
+def test_min_max_aggregates(coord):
+    coord.execute("CREATE TABLE t (g int, v int)")
+    coord.execute("INSERT INTO t VALUES (1, 5), (1, 9), (2, 3)")
+    r = coord.execute(
+        "SELECT g, min(v), max(v), count(*) FROM t GROUP BY g ORDER BY g"
+    )
+    assert r.rows == [(1, 5, 9, 2), (2, 3, 3, 1)]
+
+
+def test_delete(coord):
+    coord.execute("CREATE TABLE t (a int)")
+    coord.execute("INSERT INTO t VALUES (1), (2), (3)")
+    coord.execute("DELETE FROM t WHERE a < 3")
+    assert coord.execute("SELECT a FROM t").rows == [(3,)]
+
+
+def test_strings_roundtrip(coord):
+    coord.execute("CREATE TABLE t (name text, v int)")
+    coord.execute("INSERT INTO t VALUES ('alice', 1), ('bob', 2)")
+    r = coord.execute("SELECT name, v FROM t WHERE name = 'bob'")
+    assert r.rows == [("bob", 2)]
+
+
+def test_show_and_explain(coord):
+    coord.execute("CREATE TABLE t (a int)")
+    assert ("t",) in coord.execute("SHOW TABLES").rows
+    r = coord.execute("EXPLAIN SELECT a FROM t WHERE a > 1")
+    text = "\n".join(row[0] for row in r.rows)
+    assert "Get" in text
+
+
+def test_limit_orderby(coord):
+    coord.execute("CREATE TABLE t (a int)")
+    coord.execute("INSERT INTO t VALUES (5), (3), (8), (1)")
+    r = coord.execute("SELECT a FROM t ORDER BY a DESC LIMIT 2")
+    assert r.rows == [(8,), (5,)]
+
+
+def test_error_division_by_zero(coord):
+    coord.execute("CREATE TABLE t (a int, b int)")
+    coord.execute("INSERT INTO t VALUES (6, 2), (5, 0)")
+    with pytest.raises(RuntimeError, match="error"):
+        coord.execute("SELECT a / b FROM t")
+    # guarded division is fine
+    r = coord.execute("SELECT a / b FROM t WHERE b <> 0")
+    assert r.rows == [(3,)]
+
+
+def test_numeric_fixed_point(coord):
+    coord.execute("CREATE TABLE li (price numeric, disc numeric)")
+    coord.execute("INSERT INTO li VALUES (100.00, 0.05), (50.00, 0.10)")
+    r = coord.execute("SELECT sum(price * (1 - disc)) FROM li")
+    # 100*0.95 + 50*0.90 = 95 + 45 = 140, scale 4
+    assert r.rows == [(140.0,)]
