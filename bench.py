@@ -1,0 +1,205 @@
+"""Benchmark: TPC-H Q3 incremental-view maintenance updates/sec.
+
+Measures the fused single-chip Q3 tick (materialize_tpu/models/fused_q3.py)
+on whatever device JAX provides (the real TPU under the driver), against a
+vectorized NumPy incremental maintainer of the same view on host CPU —
+the stand-in for the reference's 8-core CPU posture (BASELINE.md: no absolute
+numbers are published; the methodology is relative).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: MZT_BENCH_SF (default 0.1), MZT_BENCH_TICKS (default 5),
+MZT_BENCH_FRAC (default 0.005 — fraction of orders churned per tick).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def build_tpu_side(sf, seed):
+    import jax
+
+    import materialize_tpu  # noqa: F401
+    from materialize_tpu.models.fused_q3 import Q3Caps, Q3State, q3_tick_single
+    from materialize_tpu.repr.batch import bucket_cap
+    from materialize_tpu.storage import TpchGenerator
+
+    gen = TpchGenerator(sf=sf, seed=seed)
+    init = gen.initial_batches(1)
+    n_orders = gen.n_orders
+    n_li = len(gen._lineitem_store[0]) if gen._lineitem_store else int(4 * n_orders)
+    caps = Q3Caps(
+        cust=bucket_cap(max(gen.n_customer // 4, 64)),
+        orders=bucket_cap(max(int(n_orders * 0.55), 64)),
+        lineitem=bucket_cap(max(int(n_li * 0.65), 64)),
+        delta=1 << 10,
+        bucket=1 << 10,
+        join_out=bucket_cap(max(int(n_li * 0.35), 256)),
+        groups=bucket_cap(max(int(n_orders * 0.35), 64)),
+    )
+    step = jax.jit(q3_tick_single(caps))
+    state = Q3State.empty(caps)
+    return gen, init, caps, step, state
+
+
+def run_tpu(sf, ticks, frac, seed=0):
+    import jax
+
+    gen, init, caps, step, state = build_tpu_side(sf, seed)
+    # initial hydration (not timed: reference benches steady-state updates)
+    state, out, errs, over = step(
+        state, init["customer"], init["orders"], init["lineitem"], np.uint64(1)
+    )
+    jax.block_until_ready(out.diffs)
+    if bool(np.asarray(over).any()):
+        print("WARNING: overflow during hydration; caps too small", file=sys.stderr)
+
+    # pre-generate refresh ticks (host generation excluded from timing)
+    from materialize_tpu.repr import UpdateBatch
+
+    empty_c = UpdateBatch.empty(8, (), (np.dtype(np.int64),) * 3)
+    refreshes = []
+    n_updates = 0
+    for t in range(2, 2 + ticks + 1):  # +1 warmup
+        r = gen.refresh(t, frac=frac)
+        n_updates += int(r["orders"].count()) + int(r["lineitem"].count())
+        refreshes.append((t, r))
+
+    # warmup tick (compile for refresh shapes)
+    t0, r0 = refreshes[0]
+    state, out, errs, over = step(state, empty_c, r0["orders"], r0["lineitem"], np.uint64(t0))
+    jax.block_until_ready(out.diffs)
+    warm_updates = int(r0["orders"].count()) + int(r0["lineitem"].count())
+
+    start = time.perf_counter()
+    total = 0
+    for t, r in refreshes[1:]:
+        state, out, errs, over = step(
+            state, empty_c, r["orders"], r["lineitem"], np.uint64(t)
+        )
+        total += int(r["orders"].count()) + int(r["lineitem"].count())
+    jax.block_until_ready(out.diffs)
+    elapsed = time.perf_counter() - start
+    if bool(np.asarray(over).any()):
+        print("WARNING: overflow during timed ticks", file=sys.stderr)
+    return total / elapsed, total, elapsed
+
+
+class NumpyQ3:
+    """Vectorized NumPy incremental Q3 maintainer (host-CPU baseline)."""
+
+    def __init__(self, customer, q3_date, building):
+        ck, seg, _ = customer
+        self.building = set(ck[seg == building].tolist())
+        self.q3_date = q3_date
+        # orderkey -> (orderdate, shippriority) for qualifying orders
+        self.orders: dict = {}
+        self.groups: dict = {}
+        # orderkey -> list of (extendedprice, discount) qualifying lineitems
+        self.li_by_order: dict = {}
+
+    def tick(self, o_cols, o_diffs, l_cols, l_diffs):
+        ok, ock, od, sp = (np.asarray(c) for c in o_cols)
+        lk, ep, dc, sd, _q, _p = (np.asarray(c) for c in l_cols)
+        o_diffs = np.asarray(o_diffs)
+        l_diffs = np.asarray(l_diffs)
+        omask = (od < self.q3_date) & np.fromiter(
+            (int(c) in self.building for c in ock), bool, len(ock)
+        )
+        for i in np.nonzero(omask)[0]:
+            key = int(ok[i])
+            if o_diffs[i] > 0:
+                self.orders[key] = (int(od[i]), int(sp[i]))
+                for (pe, pd) in self.li_by_order.get(key, ()):  # li arrived first
+                    self._bump(key, pe, pd, 1)
+            else:
+                self.orders.pop(key, None)
+                for (pe, pd) in self.li_by_order.get(key, ()):
+                    self._bump_del(key)
+        lmask = sd > self.q3_date
+        for i in np.nonzero(lmask)[0]:
+            key = int(lk[i])
+            entry = (int(ep[i]), int(dc[i]))
+            if l_diffs[i] > 0:
+                self.li_by_order.setdefault(key, []).append(entry)
+                if key in self.orders:
+                    self._bump(key, entry[0], entry[1], 1)
+            else:
+                lst = self.li_by_order.get(key)
+                if lst and entry in lst:
+                    lst.remove(entry)
+                if key in self.orders:
+                    self._bump(key, entry[0], entry[1], -1)
+
+    def _bump(self, key, ep, dc, sign):
+        od, sp = self.orders[key]
+        g = (key, od, sp)
+        self.groups[g] = self.groups.get(g, 0) + sign * ep * (100 - dc)
+        if self.groups[g] == 0:
+            del self.groups[g]
+
+    def _bump_del(self, key):
+        # order retracted: remove the whole group
+        for g in [g for g in self.groups if g[0] == key]:
+            del self.groups[g]
+
+
+def run_cpu_baseline(sf, ticks, frac, seed=0):
+    from materialize_tpu.models.tpch import BUILDING, Q3_DATE
+    from materialize_tpu.storage import TpchGenerator
+
+    gen = TpchGenerator(sf=sf, seed=seed)
+    t = gen.initial()
+    maintainer = NumpyQ3(t.customer, Q3_DATE, BUILDING)
+    n0 = len(t.orders[0])
+    maintainer.tick(t.orders, np.ones(n0, dtype=np.int64), t.lineitem,
+                    np.ones(len(t.lineitem[0]), dtype=np.int64))
+
+    refreshes = []
+    for tk in range(2, 2 + ticks):
+        r = gen.refresh(tk, frac=frac)
+        oo = r["orders"].to_host()
+        ll = r["lineitem"].to_host()
+        refreshes.append((oo, ll))
+    start = time.perf_counter()
+    total = 0
+    for oo, ll in refreshes:
+        maintainer.tick(oo["vals"], oo["diffs"], ll["vals"], ll["diffs"])
+        total += len(oo["diffs"]) + len(ll["diffs"])
+    elapsed = time.perf_counter() - start
+    return total / elapsed, total, elapsed
+
+
+def main():
+    sf = float(os.environ.get("MZT_BENCH_SF", "0.1"))
+    ticks = int(os.environ.get("MZT_BENCH_TICKS", "5"))
+    frac = float(os.environ.get("MZT_BENCH_FRAC", "0.005"))
+
+    tpu_rate, n_tpu, t_tpu = run_tpu(sf, ticks, frac)
+    print(
+        f"# tpu: {n_tpu} updates in {t_tpu:.3f}s = {tpu_rate:,.0f}/s",
+        file=sys.stderr,
+    )
+    cpu_rate, n_cpu, t_cpu = run_cpu_baseline(sf, ticks, frac)
+    print(
+        f"# cpu baseline: {n_cpu} updates in {t_cpu:.3f}s = {cpu_rate:,.0f}/s",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"tpch_q3_ivm_updates_per_sec_sf{sf}",
+                "value": round(tpu_rate, 1),
+                "unit": "updates/sec",
+                "vs_baseline": round(tpu_rate / cpu_rate, 3) if cpu_rate else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

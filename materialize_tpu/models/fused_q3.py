@@ -1,0 +1,261 @@
+"""TPC-H Q3 as ONE fused XLA program per tick — single-chip or mesh-sharded.
+
+This is the flagship "whole tick under jit" path (SURVEY.md §7 design
+stance): filters, the three delta-join paths, the revenue closure and the
+accumulable reduce compile into a single program. On a mesh, arrangements are
+hash-sharded by their key over the `workers` axis and every key change is an
+`all_to_all` exchange (parallel/exchange.py) — the timely-worker config-5
+shape (BASELINE.md) with collectives riding ICI.
+
+All capacities are static (pytree state); overflow flags replace resizing.
+The host-orchestrated runtime (dataflow/runtime.py) remains the general
+engine; this module is the performance path for the benchmark plan shape.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..arrangement.spine import arrange_batch
+from ..expr import CallBinary, Column, Literal, MapFilterProject
+from ..ops.consolidate import consolidate
+from ..ops.reduce import AccumState, AggregateExpr
+from ..parallel.exchange import exchange
+from ..parallel.fused import (
+    arrangement_insert,
+    fused_accumulable_step,
+    fused_join_delta,
+)
+from ..repr.batch import UpdateBatch
+from .tpch import BUILDING, Q3_DATE
+
+I64 = np.dtype(np.int64)
+
+
+@dataclass(frozen=True)
+class Q3Caps:
+    """Static capacities (per shard)."""
+
+    cust: int = 1 << 14
+    orders: int = 1 << 15
+    lineitem: int = 1 << 16
+    delta: int = 1 << 10  # per-tick delta rows per input (pre-exchange)
+    bucket: int = 1 << 9  # per-destination exchange bucket
+    join_out: int = 1 << 12
+    groups: int = 1 << 15
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class Q3State:
+    cust_by_ck: UpdateBatch  # (ck)
+    ord_by_ck: UpdateBatch  # (ok, ck, od, sp) keyed ck
+    ord_by_ok: UpdateBatch  # keyed ok
+    li_by_ok: UpdateBatch  # (lk, ep, dc) keyed lk
+    accum: AccumState  # key (lk, od, sp) -> sum(rev)
+
+    def tree_flatten(self):
+        return (
+            (self.cust_by_ck, self.ord_by_ck, self.ord_by_ok, self.li_by_ok, self.accum),
+            None,
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def empty(caps: Q3Caps) -> "Q3State":
+        return Q3State(
+            cust_by_ck=UpdateBatch.empty(caps.cust, (I64,), (I64,)),
+            ord_by_ck=UpdateBatch.empty(caps.orders, (I64,), (I64,) * 4),
+            ord_by_ok=UpdateBatch.empty(caps.orders, (I64,), (I64,) * 4),
+            li_by_ok=UpdateBatch.empty(caps.lineitem, (I64,), (I64,) * 3),
+            accum=AccumState.empty(caps.groups, (I64, I64, I64), (I64,)),
+        )
+
+
+_CUST_MFP = MapFilterProject(
+    3, predicates=(CallBinary("eq", Column(1), Literal(BUILDING)),), projection=(0,)
+)
+_ORD_MFP = MapFilterProject(
+    4, predicates=(CallBinary("lt", Column(2), Literal(Q3_DATE)),), projection=(0, 1, 2, 3)
+)
+_LI_MFP = MapFilterProject(
+    6, predicates=(CallBinary("gt", Column(3), Literal(Q3_DATE)),), projection=(0, 1, 2)
+)
+# canonical join output: (ck, ok, ck, od, sp, lk, ep, dc)
+_CLOSURE = MapFilterProject(
+    8,
+    map_exprs=(CallBinary("mul", Column(6), CallBinary("sub", Literal(100), Column(7))),),
+    projection=(5, 3, 4, 8),  # (lk, od, sp, rev)
+)
+_AGGS = (AggregateExpr("sum", Column(3)),)
+
+
+def _maybe_exchange(batch, axis_name, n_shards, bucket):
+    if axis_name is None:
+        return batch, jnp.asarray(False)
+    return exchange(batch, axis_name, n_shards, bucket)
+
+
+def _project_cols(batch: UpdateBatch, perm) -> UpdateBatch:
+    return UpdateBatch(
+        batch.hashes, (), tuple(batch.vals[i] for i in perm), batch.times, batch.diffs
+    )
+
+
+def q3_tick(
+    state: Q3State,
+    d_cust: UpdateBatch,
+    d_ord: UpdateBatch,
+    d_li: UpdateBatch,
+    time,
+    *,
+    caps: Q3Caps,
+    axis_name: str | None = None,
+    n_shards: int = 1,
+):
+    """One Q3 maintenance tick. Returns (state', out_delta, errs, overflow).
+
+    Raw deltas carry full table schemas; on a mesh each device feeds its own
+    slice and rows are routed by key hash.
+    """
+    over = jnp.asarray(False)
+
+    def track(flag):
+        nonlocal over
+        over = over | flag
+
+    fc, _ = _CUST_MFP.apply(d_cust)
+    fo, _ = _ORD_MFP.apply(d_ord)
+    fl, _ = _LI_MFP.apply(d_li)
+
+    dc = arrange_batch(fc, (0,))
+    do_ck = arrange_batch(fo, (1,))
+    do_ok = arrange_batch(fo, (0,))
+    dl = arrange_batch(fl, (0,))
+
+    dc, f = _maybe_exchange(dc, axis_name, n_shards, caps.bucket)
+    track(f)
+    do_ck, f = _maybe_exchange(do_ck, axis_name, n_shards, caps.bucket)
+    track(f)
+    do_ok, f = _maybe_exchange(do_ok, axis_name, n_shards, caps.bucket)
+    track(f)
+    dl, f = _maybe_exchange(dl, axis_name, n_shards, caps.bucket)
+    track(f)
+    dc = consolidate(dc)
+    do_ck = consolidate(do_ck)
+    do_ok = consolidate(do_ok)
+    dl = consolidate(dl)
+
+    outs = []
+    # path 0: d customer ⋈ orders(ck) ⋈ lineitem(ok)
+    s0, f = fused_join_delta(dc, state.ord_by_ck, caps.join_out)
+    track(f)
+    s0 = arrange_batch(s0, (1,))  # key ok
+    s0, f = _maybe_exchange(s0, axis_name, n_shards, caps.bucket)
+    track(f)
+    s0, f = fused_join_delta(consolidate(s0), state.li_by_ok, caps.join_out)
+    track(f)
+    outs.append(s0)  # (ck | ok,ck,od,sp | lk,ep,dc) = canonical
+    new_cust, f = arrangement_insert(state.cust_by_ck, dc)
+    track(f)
+
+    # path 1: d orders ⋈ customer(ck) ⋈ lineitem(ok)
+    s1, f = fused_join_delta(do_ck, new_cust, caps.join_out)
+    track(f)
+    s1 = arrange_batch(s1, (0,))  # stream (ok,ck,od,sp | ck): key ok
+    s1, f = _maybe_exchange(s1, axis_name, n_shards, caps.bucket)
+    track(f)
+    s1, f = fused_join_delta(consolidate(s1), state.li_by_ok, caps.join_out)
+    track(f)
+    outs.append(_project_cols(s1, (4, 0, 1, 2, 3, 5, 6, 7)))
+    new_ord_ck, f = arrangement_insert(state.ord_by_ck, do_ck)
+    track(f)
+    new_ord_ok, f = arrangement_insert(state.ord_by_ok, do_ok)
+    track(f)
+
+    # path 2: d lineitem ⋈ orders(ok) ⋈ customer(ck)
+    s2, f = fused_join_delta(dl, new_ord_ok, caps.join_out)
+    track(f)
+    s2 = arrange_batch(s2, (4,))  # stream (lk,ep,dc | ok,ck,od,sp): key ck
+    s2, f = _maybe_exchange(s2, axis_name, n_shards, caps.bucket)
+    track(f)
+    s2, f = fused_join_delta(consolidate(s2), new_cust, caps.join_out)
+    track(f)
+    outs.append(_project_cols(s2, (7, 3, 4, 5, 6, 0, 1, 2)))
+    new_li, f = arrangement_insert(state.li_by_ok, dl)
+    track(f)
+
+    # closure + reduce
+    acc = outs[0]
+    for o in outs[1:]:
+        acc = UpdateBatch.concat(acc, o)
+    joined, errs1 = _CLOSURE.apply(consolidate(acc))
+    grouped = arrange_batch(joined, (0, 1, 2))
+    grouped, f = _maybe_exchange(grouped, axis_name, n_shards, caps.bucket)
+    track(f)
+    new_accum, out, errs2, f = fused_accumulable_step(
+        state.accum, consolidate(grouped), (0, 1, 2), _AGGS, time
+    )
+    track(f)
+    errs = consolidate(UpdateBatch.concat(errs1, errs2))
+    new_state = Q3State(new_cust, new_ord_ck, new_ord_ok, new_li, new_accum)
+    # overflow as shape-(1,) so shard_map can concatenate per-device flags
+    return new_state, out, errs, over.reshape((1,))
+
+
+def q3_state_global(caps: Q3Caps, n_shards: int) -> Q3State:
+    """Global (unsharded-view) empty state for an n-shard mesh: every array is
+    n× the per-shard capacity along axis 0; shard_map splits it evenly."""
+    scaled = Q3Caps(
+        cust=caps.cust * n_shards,
+        orders=caps.orders * n_shards,
+        lineitem=caps.lineitem * n_shards,
+        delta=caps.delta,
+        bucket=caps.bucket,
+        join_out=caps.join_out,
+        groups=caps.groups * n_shards,
+    )
+    return Q3State.empty(scaled)
+
+
+def q3_tick_single(caps: Q3Caps):
+    """Single-chip jittable tick: (state, d_cust, d_ord, d_li, t) → …"""
+    return partial(q3_tick, caps=caps, axis_name=None, n_shards=1)
+
+
+def q3_tick_sharded(mesh, caps: Q3Caps, axis_name: str = "workers"):
+    """Mesh-sharded tick via shard_map; inputs/state sharded on axis 0."""
+    from jax.sharding import PartitionSpec as P
+
+    n = mesh.shape[axis_name]
+    spec = P(axis_name)
+    rep = P()
+
+    def step(state, d_cust, d_ord, d_li, time):
+        return q3_tick(
+            state, d_cust, d_ord, d_li, time,
+            caps=caps, axis_name=axis_name, n_shards=n,
+        )
+
+    try:
+        shard_map = jax.shard_map
+    except AttributeError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        shard_map = _sm
+    return jax.jit(
+        shard_map(
+            step,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, spec, rep),
+            out_specs=(spec, spec, spec, spec),
+        )
+    )

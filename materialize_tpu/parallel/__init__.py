@@ -1,0 +1,13 @@
+from .exchange import exchange, route_to_buckets
+from .fused import arrangement_insert, fused_accumulable_step, fused_join_delta
+from .mesh import WORKERS, make_mesh
+
+__all__ = [
+    "exchange",
+    "route_to_buckets",
+    "arrangement_insert",
+    "fused_accumulable_step",
+    "fused_join_delta",
+    "WORKERS",
+    "make_mesh",
+]

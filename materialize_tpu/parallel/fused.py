@@ -1,0 +1,71 @@
+"""Fused fixed-capacity operator steps — pure functions for jit/shard_map.
+
+The host-orchestrated runtime (dataflow/runtime.py) sizes outputs with host
+round-trips; under `shard_map`/`jit` everything must be static shapes. These
+wrappers fix every capacity up front and report overflow flags instead of
+resizing — the whole dataflow tick becomes ONE XLA program, which is the
+design point of the TPU build (SURVEY.md §7: host drives pjit-ed steps).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.consolidate import consolidate
+from ..ops.join import join_materialize
+from ..ops.reduce import (
+    AccumState,
+    _contributions,
+    _emit_output,
+    consolidate_accums,
+    lookup_accums,
+)
+from ..repr.batch import UpdateBatch
+
+
+def arrangement_insert(arr: UpdateBatch, delta: UpdateBatch):
+    """Insert a (keyed, consolidated) delta into a fixed-cap arrangement batch.
+
+    Returns (arr', overflow). arr' keeps arr's capacity; overflow=True means
+    live rows were dropped (host must rebuild with a bigger arrangement).
+    """
+    cap = arr.cap
+    merged = consolidate(UpdateBatch.concat(arr, delta))
+    count = merged.count()
+    overflow = count > cap
+    return merged.with_capacity(cap), overflow
+
+
+def fused_accumulable_step(
+    state: AccumState,
+    delta: UpdateBatch,
+    key_cols: tuple[int, ...],
+    aggs: tuple,
+    time,
+):
+    """accumulable_step with state capacity held fixed (pure, jittable).
+
+    Returns (state', out, errs, overflow).
+    """
+    cap = state.cap
+    raw, errs = _contributions(delta, key_cols, aggs)
+    contrib = consolidate_accums(raw)
+    _found, old_accums, old_nrows = lookup_accums(state, contrib)
+    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
+    merged = consolidate_accums(AccumState.concat(state, contrib))
+    overflow = merged.count() > cap
+    return merged.with_capacity(cap), out, errs, overflow
+
+
+def fused_join_delta(
+    probe: UpdateBatch, arr: UpdateBatch, out_cap: int, swap: bool = False
+):
+    """join with static output capacity; returns (out, overflow)."""
+    from ..ops.join import join_total
+
+    total = join_total(probe, arr)
+    out = join_materialize(probe, arr, out_cap, swap)
+    return out, total > out_cap

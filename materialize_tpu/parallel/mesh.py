@@ -1,0 +1,25 @@
+"""Mesh construction helpers for worker-sharded dataflows."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+WORKERS = "workers"
+
+
+def make_mesh(n_devices: int | None = None, axis_name: str = WORKERS) -> Mesh:
+    """A 1-D mesh of `n_devices` over the available devices.
+
+    The engine's parallelism is key-hash sharding of arrangements over
+    workers (the timely-worker analogue, SURVEY.md §2e.1); a single mesh axis
+    carries it. Pipeline/tensor-style axes don't apply to dataflow state.
+    """
+    devs = jax.devices()
+    if n_devices is None:
+        n_devices = len(devs)
+    if len(devs) < n_devices:
+        raise ValueError(f"need {n_devices} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n_devices]), (axis_name,))
