@@ -20,7 +20,7 @@ import time
 import numpy as np
 
 
-def build_tpu_side(sf, seed):
+def build_tpu_side(sf, ticks, frac, seed):
     import jax
 
     import materialize_tpu  # noqa: F401
@@ -32,16 +32,19 @@ def build_tpu_side(sf, seed):
     init = gen.initial_batches(1)
     n_orders = gen.n_orders
     n_li = len(gen._lineitem_store[0]) if gen._lineitem_store else int(4 * n_orders)
+    per_tick = int(n_orders * frac * 2 * 5.5) + 64  # RF1+RF2 orders + lineitems
     caps = Q3Caps(
         cust=bucket_cap(max(gen.n_customer // 4, 64)),
         orders=bucket_cap(max(int(n_orders * 0.55), 64)),
         lineitem=bucket_cap(max(int(n_li * 0.65), 64)),
-        delta=1 << 10,
+        delta=bucket_cap(per_tick),
         bucket=1 << 10,
-        join_out=bucket_cap(max(int(n_li * 0.35), 256)),
+        join_out=bucket_cap(per_tick * 2),
         groups=bucket_cap(max(int(n_orders * 0.35), 64)),
     )
-    step = jax.jit(q3_tick_single(caps))
+    # steady-state ticks never touch customer (TPC-H RF1/RF2): compile the
+    # variant with the customer path statically removed
+    step = jax.jit(q3_tick_single(caps, with_cust=False))
     state = Q3State.empty(caps)
     return gen, init, caps, step, state
 
@@ -49,14 +52,12 @@ def build_tpu_side(sf, seed):
 def run_tpu(sf, ticks, frac, seed=0):
     import jax
 
-    gen, init, caps, step, state = build_tpu_side(sf, seed)
-    # initial hydration (not timed: reference benches steady-state updates)
-    state, out, errs, over = step(
-        state, init["customer"], init["orders"], init["lineitem"], np.uint64(1)
-    )
-    jax.block_until_ready(out.diffs)
-    if bool(np.asarray(over).any()):
-        print("WARNING: overflow during hydration; caps too small", file=sys.stderr)
+    gen, init, caps, step, state = build_tpu_side(sf, ticks, frac, seed)
+    # initial hydration (bulk path, not timed: reference benches steady-state)
+    from materialize_tpu.models.fused_q3 import hydrate
+
+    state = hydrate(state, init["customer"], init["orders"], init["lineitem"], 1)
+    jax.block_until_ready(state.accum.levels[-1].nrows)
 
     # pre-generate refresh ticks (host generation excluded from timing)
     from materialize_tpu.repr import UpdateBatch

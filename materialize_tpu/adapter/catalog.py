@@ -72,10 +72,12 @@ class Catalog:
     def __init__(self) -> None:
         self.items: dict[str, CatalogItem] = {}
         self.dict = StringDictionary()
-        self._ids = itertools.count()
+        self._next_id = 0
 
     def allocate_id(self, prefix: str = "u") -> str:
-        return f"{prefix}{next(self._ids)}"
+        v = self._next_id
+        self._next_id += 1
+        return f"{prefix}{v}"
 
     def create(self, item: CatalogItem) -> CatalogItem:
         if item.name in self.items:

@@ -65,7 +65,13 @@ class StorageCollection:
 
 
 class Coordinator:
-    def __init__(self) -> None:
+    """Pass `data_dir` (or blob+consensus) for durability: the catalog and
+    every collection live in persist shards and a restart rebuilds dataflows
+    and rehydrates arrangements from snapshots — the reference's recovery
+    model (SURVEY.md §5 checkpoint/resume: durable state is only shards +
+    the durable catalog; everything else re-renders)."""
+
+    def __init__(self, data_dir: str | None = None, blob=None, consensus=None) -> None:
         self.catalog = Catalog()
         self.oracle = TimestampOracle()
         self.storage: dict[str, StorageCollection] = {}
@@ -73,6 +79,20 @@ class Coordinator:
         # installed continuous dataflows in dependency order: (mv_gid, Dataflow, src_gids)
         self.dataflows: list = []
         self.planner = Planner(self.catalog)
+        self.blob = blob
+        self.consensus = consensus
+        if data_dir is not None:
+            from ..persist import FileBlob, FileConsensus
+
+            self.blob = FileBlob(f"{data_dir}/blob")
+            self.consensus = FileConsensus(f"{data_dir}/consensus")
+        self.shards: dict[str, object] = {}  # gid -> ShardMachine
+        if self.durable:
+            self._boot()
+
+    @property
+    def durable(self) -> bool:
+        return self.blob is not None and self.consensus is not None
 
     # -- public API ----------------------------------------------------------
     def execute(self, sql: str) -> ExecResult:
@@ -116,7 +136,8 @@ class Coordinator:
         desc = RelationDesc(cols)
         item = self.catalog.create(CatalogItem(stmt.name, "table", desc=desc))
         self.storage[item.global_id] = StorageCollection(desc.dtypes)
-        return ExecResult("status", status=f"CREATE TABLE")
+        self._persist_catalog()
+        return ExecResult("status", status="CREATE TABLE")
 
     _AUCTION_TABLES = {
         "organizations": RelationDesc.of(
@@ -191,6 +212,7 @@ class Coordinator:
             ts = self.oracle.write_ts()
             init = gen.initial_batches(ts)
             self._apply_writes({gids[t]: b for t, b in init.items()}, ts)
+        self._persist_catalog()
         return ExecResult("status", status="CREATE SOURCE")
 
     def _create_view(self, stmt: ast.CreateView) -> ExecResult:
@@ -198,6 +220,7 @@ class Coordinator:
         self.catalog.create(
             CatalogItem(stmt.name, "view", desc=pq.desc, query_ast=stmt.query, mir=pq)
         )
+        self._persist_catalog()
         return ExecResult("status", status="CREATE VIEW")
 
     def _create_materialized_view(self, stmt: ast.CreateMaterializedView) -> ExecResult:
@@ -226,6 +249,7 @@ class Coordinator:
             self.storage[gid].append(out[0], as_of)
         self.dataflows.append((gid, df, src_gids))
         item.mir = rel
+        self._persist_catalog()
         return ExecResult("status", status="CREATE MATERIALIZED VIEW")
 
     def _create_index(self, stmt: ast.CreateIndex) -> ExecResult:
@@ -235,6 +259,7 @@ class Coordinator:
         self.catalog.create(
             CatalogItem(name, "index", index_on=stmt.on, index_key=key)
         )
+        self._persist_catalog()
         return ExecResult("status", status="CREATE INDEX")
 
     def _drop(self, stmt: ast.DropObject) -> ExecResult:
@@ -242,6 +267,7 @@ class Coordinator:
         if item is not None:
             self.storage.pop(item.global_id, None)
             self.dataflows = [d for d in self.dataflows if d[0] != item.global_id]
+        self._persist_catalog()
         return ExecResult("status", status=f"DROP {stmt.kind.upper()}")
 
     # -- DML -------------------------------------------------------------------
@@ -325,11 +351,153 @@ class Coordinator:
             return int(date_num(y, m, d))
         raise PlanError(f"unsupported literal {e!r}")
 
+    # -- durability ------------------------------------------------------------
+    def _shard(self, gid: str):
+        from ..persist import ShardMachine
+
+        m = self.shards.get(gid)
+        if m is None:
+            m = ShardMachine(self.blob, self.consensus, gid)
+            self.shards[gid] = m
+        return m
+
+    def _persist_catalog(self) -> None:
+        """Write the durable catalog (reference: persist-backed catalog shard,
+        src/catalog/src/durable). Pickled: single-node durability; a
+        proto/json codec slots in here for cross-version upgrades."""
+        if not self.durable:
+            return
+        import pickle
+
+        items = []
+        for it in self.catalog.items.values():
+            items.append(
+                {
+                    "name": it.name,
+                    "kind": it.kind,
+                    "desc": it.desc,
+                    "query_ast": it.query_ast,
+                    "index_on": it.index_on,
+                    "index_key": it.index_key,
+                    "generator": it.generator,
+                    "options": it.options,
+                    "global_id": it.global_id,
+                }
+            )
+        doc = pickle.dumps(
+            {
+                "items": items,
+                "strings": list(self.catalog.dict._strs),
+                "ts": self.oracle.read_ts(),
+                "generators": pickle.dumps(self.generators),
+                "next_id": self.catalog._next_id,
+            }
+        )
+        for _ in range(8):
+            head = self.consensus.head("catalog")
+            seq = head.seqno if head is not None else None
+            if self.consensus.compare_and_set("catalog", seq, doc):
+                self._persisted_dict_len = len(self.catalog.dict)
+                return
+        raise RuntimeError("catalog CAS contention")
+
+    def checkpoint(self) -> None:
+        """Persist catalog + generator progress (clean-shutdown durability for
+        load-generator sources; table/MV data is crash-consistent via shards)."""
+        self._persist_catalog()
+
+    def _boot(self) -> None:
+        """Restart: reload catalog, rehydrate storage, re-render dataflows."""
+        import itertools
+        import pickle
+
+        head = self.consensus.head("catalog")
+        if head is None:
+            return
+        doc = pickle.loads(head.data)
+        self.catalog._next_id = doc["next_id"]
+        for s in doc["strings"]:
+            self.catalog.dict.encode(s)
+        self.oracle.apply_write(doc["ts"])
+        self.catalog._ids = itertools.count(doc["next_id"])
+        self.generators = pickle.loads(doc["generators"])
+        mvs = []
+        gen_gids: dict[str, str] = {}
+        for d in doc["items"]:
+            item = CatalogItem(
+                d["name"], d["kind"], desc=d["desc"], query_ast=d["query_ast"],
+                index_on=d["index_on"], index_key=d["index_key"],
+                generator=d["generator"], options=d["options"],
+                global_id=d["global_id"],
+            )
+            self.catalog.items[item.name] = item
+            if item.kind in ("table", "source"):
+                self.storage[item.global_id] = StorageCollection(item.desc.dtypes)
+                self._rehydrate_collection(item.global_id)
+            elif item.kind == "view":
+                item.mir = self.planner.plan_query(item.query_ast)
+            elif item.kind == "materialized_view":
+                mvs.append(item)
+        # regenerate generator gid maps from table names (stored order kept)
+        for gen, gids in self.generators:
+            for t in list(gids):
+                gids[t] = self.catalog.get(t).global_id
+        # reads must observe every committed shard write, even ones after the
+        # last catalog persist: advance the oracle to the max shard upper
+        for d in doc["items"]:
+            if d["kind"] in ("table", "source", "materialized_view"):
+                up = self._shard(d["global_id"]).upper()
+                if up > 0:
+                    self.oracle.apply_write(up - 1)
+        for item in mvs:
+            self.storage[item.global_id] = StorageCollection(item.desc.dtypes)
+            self._reinstall_mv(item)
+
+    def _rehydrate_collection(self, gid: str) -> None:
+        from ..persist import ShardMachine
+
+        m = self._shard(gid)
+        _seq, state = m.fetch_state()
+        if state.upper <= state.since and not state.batches:
+            return
+        store = self.storage[gid]
+        for cols in m.snapshot(max(state.upper - 1, state.since)):
+            data = [cols[f"c{i}"] for i in range(len(store.dtypes))]
+            batch = UpdateBatch.build((), tuple(data), cols["times"], cols["diffs"])
+            store.arr.insert(batch)
+        store.upper = state.upper
+
+    def _reinstall_mv(self, item: CatalogItem) -> None:
+        """Re-plan + re-render an MV and hydrate from input snapshots."""
+        from ..sql.lower import lower_to_dataflow as _lower
+        from ..transform import optimize as _opt
+
+        pq = self.planner.plan_query(item.query_ast)
+        rel = pq.mir
+        if pq.finishing.limit is not None:
+            from ..sql.plan import _apply_finishing_as_topk
+
+            rel = _apply_finishing_as_topk(pq)
+        rel = _opt(rel)
+        item.mir = rel
+        gid = item.global_id
+        src_gids = sorted(_collect_gets(rel))
+        env = {g: self.storage[g].dtypes for g in src_gids}
+        desc = _lower(gid, rel, env, src_gids, index_key=(), as_of=0)
+        df = Dataflow(desc)
+        as_of = self.oracle.read_ts()
+        snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
+        results = df.step(as_of, snaps)
+        out = results.get(gid)
+        if out is not None and out[0] is not None:
+            self.storage[gid].append(out[0], as_of)
+        self.dataflows.append((gid, df, src_gids))
+
     # -- write propagation -----------------------------------------------------
     def _apply_writes(self, writes: dict[str, UpdateBatch], ts: int) -> None:
-        """Group commit: append to storage, then flow through every installed
-        dataflow in dependency order (an MV's output delta becomes visible to
-        downstream MVs at the same timestamp)."""
+        """Group commit: append to storage (and persist shards), then flow
+        through every installed dataflow in dependency order (an MV's output
+        delta becomes visible to downstream MVs at the same timestamp)."""
         env = dict(writes)
         for gid, batch in writes.items():
             self.storage[gid].append(batch, ts)
@@ -343,6 +511,19 @@ class Coordinator:
             if out is not None and out[0] is not None:
                 env[mv_gid] = out[0]
                 self.storage[mv_gid].append(out[0], ts)
+        if self.durable:
+            from ..persist import UpperMismatch
+
+            for gid, batch in env.items():
+                m = self._shard(gid)
+                h = batch.to_host()
+                cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
+                cols["times"] = h["times"]
+                cols["diffs"] = h["diffs"]
+                lower = m.upper()
+                m.compare_and_append(cols, lower, ts + 1)
+            if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
+                self._persist_catalog()
 
     def advance(self, n_rows: int = 100) -> int:
         """Pull one batch from every generator source and commit it."""

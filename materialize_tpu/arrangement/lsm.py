@@ -1,0 +1,203 @@
+"""LSM arrangements inside jit: geometric levels, deterministic merge schedule.
+
+The host-side spine (spine.py) sizes merges with host decisions; under jit
+every shape must be static, so this variant keeps K fixed-capacity levels and
+merges level i into i+1 whenever ``tick % ratio^(i+1) == 0`` via `lax.cond` —
+a deterministic schedule with the same amortized O(N/ratio^i) merge cost as
+differential's spine, but compiled once. This is what makes the fused tick
+O(delta · log N) instead of O(N): without it every insert re-sorts the whole
+arrangement (reference analogue: differential `Spine` merge batching;
+doc/developer/arrangements.md).
+
+Probes search every level (K binary searches) and sum contributions; for the
+accumulator table the per-level partial accumulators sum to the true total,
+so lookups add across levels.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.consolidate import consolidate
+from ..ops.join import join_materialize, join_total
+from ..ops.reduce import AccumState, consolidate_accums, lookup_accums
+from ..repr.batch import UpdateBatch
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class LsmBatches:
+    """K levels of consolidated sorted batches, small → large."""
+
+    levels: tuple  # tuple[UpdateBatch]
+
+    def tree_flatten(self):
+        return (self.levels,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def empty(caps: tuple, key_dtypes, val_dtypes) -> "LsmBatches":
+        return LsmBatches(
+            tuple(UpdateBatch.empty(c, key_dtypes, val_dtypes) for c in caps)
+        )
+
+    def count(self):
+        return sum(b.count() for b in self.levels)
+
+
+def _cleared(col: jnp.ndarray, fill) -> jnp.ndarray:
+    """Fill a column, derived from it (keeps shard_map varying-ness so both
+    lax.cond branches have identical output types)."""
+    return jnp.where(jnp.zeros((), dtype=jnp.bool_), col, jnp.asarray(fill, col.dtype))
+
+
+def _empty_like(b: UpdateBatch) -> UpdateBatch:
+    from ..repr.batch import PAD_TIME
+    from ..repr.hashing import PAD_HASH
+
+    return UpdateBatch(
+        _cleared(b.hashes, PAD_HASH),
+        tuple(_cleared(k, 0) for k in b.keys),
+        tuple(_cleared(v, 0) for v in b.vals),
+        _cleared(b.times, PAD_TIME),
+        _cleared(b.diffs, 0),
+    )
+
+
+def _false_like(b) -> jnp.ndarray:
+    """A varying-typed False scalar derived from `b`."""
+    return b.count() < 0
+
+
+def lsm_insert(lsm: LsmBatches, delta: UpdateBatch, tick, ratio: int = 4):
+    """Insert a keyed, consolidated delta; run the tick's scheduled merges.
+
+    `tick` is a traced i32/i64 scalar. Returns (lsm', overflow).
+    """
+    levels = list(lsm.levels)
+    overflow = jnp.asarray(False)
+    n = len(levels)
+    tick = jnp.asarray(tick, dtype=jnp.int64)
+
+    # merges, deepest first (uses the pre-merge contents of lower levels)
+    for i in range(n - 2, -1, -1):
+        period = ratio ** (i + 1)
+        do_merge = (tick % period) == 0
+
+        def merge(args, i=i):
+            lo, hi = args
+            merged = consolidate(UpdateBatch.concat(hi, lo))
+            of = merged.count() > hi.cap
+            return _empty_like(lo), merged.with_capacity(hi.cap), of
+
+        def keep(args):
+            lo, hi = args
+            return lo, hi, _false_like(lo)
+
+        lo2, hi2, of = jax.lax.cond(do_merge, merge, keep, (levels[i], levels[i + 1]))
+        levels[i], levels[i + 1] = lo2, hi2
+        overflow = overflow | of
+
+    # delta lands in level 0
+    l0 = consolidate(UpdateBatch.concat(levels[0], delta))
+    overflow = overflow | (l0.count() > levels[0].cap)
+    levels[0] = l0.with_capacity(levels[0].cap)
+    return LsmBatches(tuple(levels)), overflow
+
+
+def lsm_join(probe: UpdateBatch, lsm: LsmBatches, out_caps: tuple, swap=False):
+    """Join a probe batch against every level. Returns (outs list, overflow)."""
+    outs = []
+    overflow = jnp.asarray(False)
+    for level, cap in zip(lsm.levels, out_caps):
+        total = join_total(probe, level)
+        outs.append(join_materialize(probe, level, cap, swap))
+        overflow = overflow | (total > cap)
+    return outs, overflow
+
+
+# ---------------------------------------------------------------------------
+# accumulator-table LSM (per-key aggregate state)
+# ---------------------------------------------------------------------------
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class LsmAccums:
+    levels: tuple  # tuple[AccumState]
+
+    def tree_flatten(self):
+        return (self.levels,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @staticmethod
+    def empty(caps: tuple, key_dtypes, accum_dtypes) -> "LsmAccums":
+        return LsmAccums(
+            tuple(AccumState.empty(c, key_dtypes, accum_dtypes) for c in caps)
+        )
+
+
+def _empty_accum_like(s: AccumState) -> AccumState:
+    from ..repr.hashing import PAD_HASH
+
+    return AccumState(
+        _cleared(s.hashes, PAD_HASH),
+        tuple(_cleared(k, 0) for k in s.keys),
+        tuple(_cleared(a, 0) for a in s.accums),
+        _cleared(s.nrows, 0),
+    )
+
+
+def accum_lsm_lookup(lsm: LsmAccums, probe: AccumState):
+    """Total accumulators for probe keys: sum of per-level partials."""
+    tot_accums = None
+    tot_nrows = None
+    for level in lsm.levels:
+        _f, accs, nrows = lookup_accums(level, probe)
+        if tot_accums is None:
+            tot_accums = list(accs)
+            tot_nrows = nrows
+        else:
+            tot_accums = [a + b for a, b in zip(tot_accums, accs)]
+            tot_nrows = tot_nrows + nrows
+    return tuple(tot_accums), tot_nrows
+
+
+def accum_lsm_insert(lsm: LsmAccums, contrib: AccumState, tick, ratio: int = 4):
+    """Add consolidated per-key contributions; run scheduled merges."""
+    levels = list(lsm.levels)
+    overflow = jnp.asarray(False)
+    n = len(levels)
+    tick = jnp.asarray(tick, dtype=jnp.int64)
+    for i in range(n - 2, -1, -1):
+        period = ratio ** (i + 1)
+        do_merge = (tick % period) == 0
+
+        def merge(args):
+            lo, hi = args
+            merged = consolidate_accums(AccumState.concat(hi, lo))
+            of = merged.count() > hi.cap
+            return _empty_accum_like(lo), merged.with_capacity(hi.cap), of
+
+        def keep(args):
+            lo, hi = args
+            return lo, hi, _false_like(lo)
+
+        lo2, hi2, of = jax.lax.cond(do_merge, merge, keep, (levels[i], levels[i + 1]))
+        levels[i], levels[i + 1] = lo2, hi2
+        overflow = overflow | of
+    l0 = consolidate_accums(AccumState.concat(levels[0], contrib))
+    overflow = overflow | (l0.count() > levels[0].cap)
+    levels[0] = l0.with_capacity(levels[0].cap)
+    return LsmAccums(tuple(levels)), overflow

@@ -2,10 +2,12 @@
 
 This is the flagship "whole tick under jit" path (SURVEY.md §7 design
 stance): filters, the three delta-join paths, the revenue closure and the
-accumulable reduce compile into a single program. On a mesh, arrangements are
-hash-sharded by their key over the `workers` axis and every key change is an
-`all_to_all` exchange (parallel/exchange.py) — the timely-worker config-5
-shape (BASELINE.md) with collectives riding ICI.
+accumulable SUM reduce compile into a single program. Arrangements are
+LSM-leveled (arrangement/lsm.py) with a deterministic merge schedule, so a
+tick costs O(delta·log N), not O(N). On a mesh, arrangements are hash-sharded
+by their key over the `workers` axis and every key change is an `all_to_all`
+exchange (parallel/exchange.py) — the timely-worker config-5 shape
+(BASELINE.md) with collectives riding ICI.
 
 All capacities are static (pytree state); overflow flags replace resizing.
 The host-orchestrated runtime (dataflow/runtime.py) remains the general
@@ -21,20 +23,36 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..arrangement.lsm import (
+    LsmAccums,
+    LsmBatches,
+    accum_lsm_insert,
+    accum_lsm_lookup,
+    lsm_insert,
+    lsm_join,
+)
 from ..arrangement.spine import arrange_batch
 from ..expr import CallBinary, Column, Literal, MapFilterProject
 from ..ops.consolidate import consolidate
-from ..ops.reduce import AccumState, AggregateExpr
+from ..ops.reduce import AggregateExpr, _contributions, _emit_output, consolidate_accums
 from ..parallel.exchange import exchange
-from ..parallel.fused import (
-    arrangement_insert,
-    fused_accumulable_step,
-    fused_join_delta,
-)
-from ..repr.batch import UpdateBatch
+from ..repr.batch import UpdateBatch, bucket_cap
 from .tpch import BUILDING, Q3_DATE
 
 I64 = np.dtype(np.int64)
+RATIO = 8  # LSM merge ratio
+
+
+def level_caps(full: int, small: int, k: int = 3) -> tuple:
+    """Geometric level capacities (small, …, full)."""
+    caps = [full]
+    for _ in range(k - 1):
+        caps.append(max(bucket_cap(small), caps[-1] // RATIO))
+    caps.reverse()
+    # monotone non-decreasing
+    for i in range(1, k):
+        caps[i] = max(caps[i], caps[i - 1])
+    return tuple(caps)
 
 
 @dataclass(frozen=True)
@@ -48,16 +66,20 @@ class Q3Caps:
     bucket: int = 1 << 9  # per-destination exchange bucket
     join_out: int = 1 << 12
     groups: int = 1 << 15
+    levels: int = 3
+
+    def arr_levels(self, full: int) -> tuple:
+        return level_caps(full, self.delta * 4, self.levels)
 
 
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class Q3State:
-    cust_by_ck: UpdateBatch  # (ck)
-    ord_by_ck: UpdateBatch  # (ok, ck, od, sp) keyed ck
-    ord_by_ok: UpdateBatch  # keyed ok
-    li_by_ok: UpdateBatch  # (lk, ep, dc) keyed lk
-    accum: AccumState  # key (lk, od, sp) -> sum(rev)
+    cust_by_ck: LsmBatches  # (ck)
+    ord_by_ck: LsmBatches  # (ok, ck, od, sp) keyed ck
+    ord_by_ok: LsmBatches  # keyed ok
+    li_by_ok: LsmBatches  # (lk, ep, dc) keyed lk
+    accum: LsmAccums  # key (lk, od, sp) -> sum(rev)
 
     def tree_flatten(self):
         return (
@@ -72,11 +94,13 @@ class Q3State:
     @staticmethod
     def empty(caps: Q3Caps) -> "Q3State":
         return Q3State(
-            cust_by_ck=UpdateBatch.empty(caps.cust, (I64,), (I64,)),
-            ord_by_ck=UpdateBatch.empty(caps.orders, (I64,), (I64,) * 4),
-            ord_by_ok=UpdateBatch.empty(caps.orders, (I64,), (I64,) * 4),
-            li_by_ok=UpdateBatch.empty(caps.lineitem, (I64,), (I64,) * 3),
-            accum=AccumState.empty(caps.groups, (I64, I64, I64), (I64,)),
+            cust_by_ck=LsmBatches.empty(caps.arr_levels(caps.cust), (I64,), (I64,)),
+            ord_by_ck=LsmBatches.empty(caps.arr_levels(caps.orders), (I64,), (I64,) * 4),
+            ord_by_ok=LsmBatches.empty(caps.arr_levels(caps.orders), (I64,), (I64,) * 4),
+            li_by_ok=LsmBatches.empty(caps.arr_levels(caps.lineitem), (I64,), (I64,) * 3),
+            accum=LsmAccums.empty(
+                caps.arr_levels(caps.groups), (I64, I64, I64), (I64,)
+            ),
         )
 
 
@@ -110,6 +134,13 @@ def _project_cols(batch: UpdateBatch, perm) -> UpdateBatch:
     )
 
 
+def _concat_all(batches: list) -> UpdateBatch:
+    acc = batches[0]
+    for b in batches[1:]:
+        acc = UpdateBatch.concat(acc, b)
+    return acc
+
+
 def q3_tick(
     state: Q3State,
     d_cust: UpdateBatch,
@@ -120,95 +151,184 @@ def q3_tick(
     caps: Q3Caps,
     axis_name: str | None = None,
     n_shards: int = 1,
+    with_cust: bool = True,
 ):
     """One Q3 maintenance tick. Returns (state', out_delta, errs, overflow).
 
     Raw deltas carry full table schemas; on a mesh each device feeds its own
-    slice and rows are routed by key hash.
+    slice and rows are routed by key hash. `time` doubles as the LSM merge
+    schedule counter, so ticks should be consecutive integers.
+
+    `with_cust=False` compiles a variant with the customer delta path
+    statically removed — the analogue of timely not scheduling operators whose
+    inputs hold no capabilities; TPC-H RF1/RF2 never touches customer.
     """
     over = jnp.asarray(False)
+    jcaps = (caps.join_out,) * caps.levels
 
     def track(flag):
         nonlocal over
         over = over | flag
 
-    fc, _ = _CUST_MFP.apply(d_cust)
     fo, _ = _ORD_MFP.apply(d_ord)
     fl, _ = _LI_MFP.apply(d_li)
 
-    dc = arrange_batch(fc, (0,))
     do_ck = arrange_batch(fo, (1,))
     do_ok = arrange_batch(fo, (0,))
     dl = arrange_batch(fl, (0,))
 
-    dc, f = _maybe_exchange(dc, axis_name, n_shards, caps.bucket)
-    track(f)
     do_ck, f = _maybe_exchange(do_ck, axis_name, n_shards, caps.bucket)
     track(f)
     do_ok, f = _maybe_exchange(do_ok, axis_name, n_shards, caps.bucket)
     track(f)
     dl, f = _maybe_exchange(dl, axis_name, n_shards, caps.bucket)
     track(f)
-    dc = consolidate(dc)
     do_ck = consolidate(do_ck)
     do_ok = consolidate(do_ok)
     dl = consolidate(dl)
 
     outs = []
-    # path 0: d customer ⋈ orders(ck) ⋈ lineitem(ok)
-    s0, f = fused_join_delta(dc, state.ord_by_ck, caps.join_out)
-    track(f)
-    s0 = arrange_batch(s0, (1,))  # key ok
-    s0, f = _maybe_exchange(s0, axis_name, n_shards, caps.bucket)
-    track(f)
-    s0, f = fused_join_delta(consolidate(s0), state.li_by_ok, caps.join_out)
-    track(f)
-    outs.append(s0)  # (ck | ok,ck,od,sp | lk,ep,dc) = canonical
-    new_cust, f = arrangement_insert(state.cust_by_ck, dc)
-    track(f)
+    if with_cust:
+        fc, _ = _CUST_MFP.apply(d_cust)
+        dc = arrange_batch(fc, (0,))
+        dc, f = _maybe_exchange(dc, axis_name, n_shards, caps.bucket)
+        track(f)
+        dc = consolidate(dc)
+        # path 0: d customer ⋈ orders(ck) ⋈ lineitem(ok)
+        s0s, f = lsm_join(dc, state.ord_by_ck, jcaps)
+        track(f)
+        s0 = arrange_batch(_concat_all(s0s), (1,))  # key ok
+        s0, f = _maybe_exchange(s0, axis_name, n_shards, caps.bucket)
+        track(f)
+        s0s, f = lsm_join(consolidate(s0), state.li_by_ok, jcaps)
+        track(f)
+        outs += s0s  # (ck | ok,ck,od,sp | lk,ep,dc) = canonical
+        new_cust, f = lsm_insert(state.cust_by_ck, dc, time, RATIO)
+        track(f)
+    else:
+        new_cust = state.cust_by_ck
 
     # path 1: d orders ⋈ customer(ck) ⋈ lineitem(ok)
-    s1, f = fused_join_delta(do_ck, new_cust, caps.join_out)
+    s1s, f = lsm_join(do_ck, new_cust, jcaps)
     track(f)
-    s1 = arrange_batch(s1, (0,))  # stream (ok,ck,od,sp | ck): key ok
+    s1 = arrange_batch(_concat_all(s1s), (0,))  # stream (ok,ck,od,sp | ck): key ok
     s1, f = _maybe_exchange(s1, axis_name, n_shards, caps.bucket)
     track(f)
-    s1, f = fused_join_delta(consolidate(s1), state.li_by_ok, caps.join_out)
+    s1s, f = lsm_join(consolidate(s1), state.li_by_ok, jcaps)
     track(f)
-    outs.append(_project_cols(s1, (4, 0, 1, 2, 3, 5, 6, 7)))
-    new_ord_ck, f = arrangement_insert(state.ord_by_ck, do_ck)
+    outs += [_project_cols(s, (4, 0, 1, 2, 3, 5, 6, 7)) for s in s1s]
+    new_ord_ck, f = lsm_insert(state.ord_by_ck, do_ck, time, RATIO)
     track(f)
-    new_ord_ok, f = arrangement_insert(state.ord_by_ok, do_ok)
+    new_ord_ok, f = lsm_insert(state.ord_by_ok, do_ok, time, RATIO)
     track(f)
 
     # path 2: d lineitem ⋈ orders(ok) ⋈ customer(ck)
-    s2, f = fused_join_delta(dl, new_ord_ok, caps.join_out)
+    s2s, f = lsm_join(dl, new_ord_ok, jcaps)
     track(f)
-    s2 = arrange_batch(s2, (4,))  # stream (lk,ep,dc | ok,ck,od,sp): key ck
+    s2 = arrange_batch(_concat_all(s2s), (4,))  # stream (lk,ep,dc | ok,ck,od,sp): key ck
     s2, f = _maybe_exchange(s2, axis_name, n_shards, caps.bucket)
     track(f)
-    s2, f = fused_join_delta(consolidate(s2), new_cust, caps.join_out)
+    s2s, f = lsm_join(consolidate(s2), new_cust, jcaps)
     track(f)
-    outs.append(_project_cols(s2, (7, 3, 4, 5, 6, 0, 1, 2)))
-    new_li, f = arrangement_insert(state.li_by_ok, dl)
+    outs += [_project_cols(s, (7, 3, 4, 5, 6, 0, 1, 2)) for s in s2s]
+    new_li, f = lsm_insert(state.li_by_ok, dl, time, RATIO)
     track(f)
 
     # closure + reduce
-    acc = outs[0]
-    for o in outs[1:]:
-        acc = UpdateBatch.concat(acc, o)
-    joined, errs1 = _CLOSURE.apply(consolidate(acc))
+    joined, errs1 = _CLOSURE.apply(consolidate(_concat_all(outs)))
     grouped = arrange_batch(joined, (0, 1, 2))
     grouped, f = _maybe_exchange(grouped, axis_name, n_shards, caps.bucket)
     track(f)
-    new_accum, out, errs2, f = fused_accumulable_step(
-        state.accum, consolidate(grouped), (0, 1, 2), _AGGS, time
-    )
+    grouped = consolidate(grouped)
+
+    raw_contrib, errs2 = _contributions(grouped, (0, 1, 2), _AGGS)
+    contrib = consolidate_accums(raw_contrib)
+    old_accums, old_nrows = accum_lsm_lookup(state.accum, contrib)
+    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
+    new_accum, f = accum_lsm_insert(state.accum, contrib, time, RATIO)
     track(f)
+
     errs = consolidate(UpdateBatch.concat(errs1, errs2))
     new_state = Q3State(new_cust, new_ord_ck, new_ord_ok, new_li, new_accum)
     # overflow as shape-(1,) so shard_map can concatenate per-device flags
     return new_state, out, errs, over.reshape((1,))
+
+
+def hydrate(state: Q3State, init_cust, init_ord, init_li, time) -> Q3State:
+    """Initial load: place filtered snapshots directly into the TOP level
+    (one-time host helper; the per-tick L0 path would overflow on a full
+    snapshot, and reference as-of hydration is likewise a bulk path)."""
+    fc, _ = _CUST_MFP.apply(init_cust)
+    fo, _ = _ORD_MFP.apply(init_ord)
+    fl, _ = _LI_MFP.apply(init_li)
+
+    def place(lsm: LsmBatches, keyed: UpdateBatch) -> LsmBatches:
+        top = lsm.levels[-1]
+        merged = consolidate(UpdateBatch.concat(top, keyed))
+        assert int(merged.count()) <= top.cap, "hydration exceeds top-level cap"
+        return LsmBatches(tuple(lsm.levels[:-1]) + (merged.with_capacity(top.cap),))
+
+    state = Q3State(
+        cust_by_ck=place(state.cust_by_ck, arrange_batch(fc, (0,))),
+        ord_by_ck=place(state.ord_by_ck, arrange_batch(fo, (1,))),
+        ord_by_ok=place(state.ord_by_ok, arrange_batch(fo, (0,))),
+        li_by_ok=place(state.li_by_ok, arrange_batch(fl, (0,))),
+        accum=state.accum,
+    )
+    # compute the initial aggregate contents through one joined pass:
+    # customer ⋈ orders ⋈ lineitem with all arrangements now full, by
+    # streaming lineitem through them (single path covers everything since
+    # the other deltas are empty).
+    dl = arrange_batch(fl, (0,))
+    out_cap = bucket_cap(max(int(dl.cap), 256))
+    from ..ops.join import join_against
+
+    s = join_against(dl, [b for b in state.ord_by_ok.levels])
+    s = consolidate(_concat_all(s)) if s else None
+    if s is not None:
+        s = arrange_batch(s, (4,))
+        s2 = join_against(s, [b for b in state.cust_by_ck.levels])
+        s2 = consolidate(_concat_all(s2)) if s2 else None
+    else:
+        s2 = None
+    if s2 is not None:
+        canonical = _project_cols(s2, (7, 3, 4, 5, 6, 0, 1, 2))
+        joined, _errs = _CLOSURE.apply(canonical)
+        grouped = arrange_batch(joined, (0, 1, 2))
+        raw_contrib, _e = _contributions(grouped, (0, 1, 2), _AGGS)
+        contrib = consolidate_accums(raw_contrib)
+        top = state.accum.levels[-1]
+        from ..ops.reduce import AccumState
+
+        merged = consolidate_accums(AccumState.concat(top, contrib.with_capacity(contrib.cap)))
+        assert int(merged.count()) <= top.cap, "hydration exceeds accum cap"
+        state = Q3State(
+            state.cust_by_ck,
+            state.ord_by_ck,
+            state.ord_by_ok,
+            state.li_by_ok,
+            LsmAccums(tuple(state.accum.levels[:-1]) + (merged.with_capacity(top.cap),)),
+        )
+    return state
+
+
+def hydration_output(state: Q3State, time) -> UpdateBatch:
+    """The initial contents of the view (all groups, diff +1) after hydrate."""
+    from ..ops.reduce import AccumState
+
+    top = state.accum.levels[-1]
+    live = top.live
+    t = jnp.asarray(time, dtype=jnp.uint64)
+    from ..repr.batch import PAD_TIME
+    from ..repr.hashing import PAD_HASH
+
+    return UpdateBatch(
+        hashes=jnp.where(live, top.hashes, PAD_HASH),
+        keys=(),
+        vals=tuple(top.keys) + tuple(top.accums),
+        times=jnp.where(live, t, PAD_TIME),
+        diffs=live.astype(jnp.int64),
+    )
 
 
 def q3_state_global(caps: Q3Caps, n_shards: int) -> Q3State:
@@ -218,17 +338,18 @@ def q3_state_global(caps: Q3Caps, n_shards: int) -> Q3State:
         cust=caps.cust * n_shards,
         orders=caps.orders * n_shards,
         lineitem=caps.lineitem * n_shards,
-        delta=caps.delta,
+        delta=caps.delta * n_shards,
         bucket=caps.bucket,
-        join_out=caps.join_out,
+        join_out=caps.join_out * n_shards,
         groups=caps.groups * n_shards,
+        levels=caps.levels,
     )
     return Q3State.empty(scaled)
 
 
-def q3_tick_single(caps: Q3Caps):
+def q3_tick_single(caps: Q3Caps, with_cust: bool = True):
     """Single-chip jittable tick: (state, d_cust, d_ord, d_li, t) → …"""
-    return partial(q3_tick, caps=caps, axis_name=None, n_shards=1)
+    return partial(q3_tick, caps=caps, axis_name=None, n_shards=1, with_cust=with_cust)
 
 
 def q3_tick_sharded(mesh, caps: Q3Caps, axis_name: str = "workers"):

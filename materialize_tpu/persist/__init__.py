@@ -1,0 +1,25 @@
+from .location import (
+    Blob,
+    Consensus,
+    FileBlob,
+    FileConsensus,
+    MemBlob,
+    MemConsensus,
+    UnreliableBlob,
+    UnreliableConsensus,
+)
+from .shard import ShardMachine, ShardState, UpperMismatch
+
+__all__ = [
+    "Blob",
+    "Consensus",
+    "FileBlob",
+    "FileConsensus",
+    "MemBlob",
+    "MemConsensus",
+    "UnreliableBlob",
+    "UnreliableConsensus",
+    "ShardMachine",
+    "ShardState",
+    "UpperMismatch",
+]

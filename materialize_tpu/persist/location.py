@@ -1,0 +1,222 @@
+"""Blob and Consensus — the durability substrate.
+
+The analogue of the reference's `Blob`/`Consensus` traits
+(src/persist/src/location.rs:570,446): an object store for immutable batch
+payloads plus a linearizable compare-and-set register for shard state.
+Implementations here: in-memory (tests) and local-filesystem (single-node
+durability; S3/distributed impls slot in behind the same interface). The
+fault-injecting wrapper mirrors persist's UnreliableBlob/Consensus
+(src/persist/src/unreliable.rs) for crash/partition testing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+
+class Blob:
+    def get(self, key: str) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def set(self, key: str, value: bytes) -> None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> None:
+        raise NotImplementedError
+
+    def list_keys(self, prefix: str = "") -> list[str]:
+        raise NotImplementedError
+
+
+class MemBlob(Blob):
+    def __init__(self) -> None:
+        self._data: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+    def set(self, key, value):
+        with self._lock:
+            self._data[key] = bytes(value)
+
+    def delete(self, key):
+        with self._lock:
+            self._data.pop(key, None)
+
+    def list_keys(self, prefix=""):
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
+
+class FileBlob(Blob):
+    """Local-FS blob store with atomic writes (tmp + rename)."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("/", "__")
+        return os.path.join(self.root, safe)
+
+    def get(self, key):
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def set(self, key, value):
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(value)
+            os.replace(tmp, self._path(key))
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def delete(self, key):
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list_keys(self, prefix=""):
+        out = []
+        for name in os.listdir(self.root):
+            key = name.replace("__", "/")
+            if key.startswith(prefix) and not name.startswith("tmp"):
+                out.append(key)
+        return sorted(out)
+
+
+@dataclass
+class CasState:
+    seqno: int
+    data: bytes
+
+
+class Consensus:
+    def head(self, key: str) -> Optional[CasState]:
+        raise NotImplementedError
+
+    def compare_and_set(
+        self, key: str, expected_seqno: Optional[int], data: bytes
+    ) -> bool:
+        """Set key to (expected_seqno+1 or 0, data) iff head seqno matches.
+
+        The linearization point of every shard state change (reference:
+        Machine::compare_and_append, machine.rs:321 rides on this).
+        """
+        raise NotImplementedError
+
+
+class MemConsensus(Consensus):
+    def __init__(self) -> None:
+        self._data: dict[str, CasState] = {}
+        self._lock = threading.Lock()
+
+    def head(self, key):
+        with self._lock:
+            return self._data.get(key)
+
+    def compare_and_set(self, key, expected_seqno, data):
+        with self._lock:
+            cur = self._data.get(key)
+            cur_seq = cur.seqno if cur is not None else None
+            if cur_seq != expected_seqno:
+                return False
+            nxt = 0 if expected_seqno is None else expected_seqno + 1
+            self._data[key] = CasState(nxt, bytes(data))
+            return True
+
+
+class FileConsensus(Consensus):
+    """Single-node durable CAS via atomic rename; seqno embedded in payload."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key.replace("/", "__") + ".json")
+
+    def head(self, key):
+        try:
+            with open(self._path(key), "rb") as f:
+                doc = json.loads(f.read())
+            return CasState(doc["seqno"], bytes.fromhex(doc["data"]))
+        except FileNotFoundError:
+            return None
+
+    def compare_and_set(self, key, expected_seqno, data):
+        with self._lock:
+            cur = self.head(key)
+            cur_seq = cur.seqno if cur is not None else None
+            if cur_seq != expected_seqno:
+                return False
+            nxt = 0 if expected_seqno is None else expected_seqno + 1
+            doc = json.dumps({"seqno": nxt, "data": bytes(data).hex()}).encode()
+            fd, tmp = tempfile.mkstemp(dir=self.root)
+            with os.fdopen(fd, "wb") as f:
+                f.write(doc)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, self._path(key))
+            return True
+
+
+class UnreliableBlob(Blob):
+    """Fault injection: fail a configurable fraction of operations."""
+
+    def __init__(self, inner: Blob, should_fail) -> None:
+        self.inner = inner
+        self.should_fail = should_fail  # callable op_name -> bool
+
+    def _check(self, op: str) -> None:
+        if self.should_fail(op):
+            raise IOError(f"unreliable blob: injected failure in {op}")
+
+    def get(self, key):
+        self._check("get")
+        return self.inner.get(key)
+
+    def set(self, key, value):
+        self._check("set")
+        self.inner.set(key, value)
+
+    def delete(self, key):
+        self._check("delete")
+        self.inner.delete(key)
+
+    def list_keys(self, prefix=""):
+        self._check("list")
+        return self.inner.list_keys(prefix)
+
+
+class UnreliableConsensus(Consensus):
+    def __init__(self, inner: Consensus, should_fail) -> None:
+        self.inner = inner
+        self.should_fail = should_fail
+
+    def head(self, key):
+        if self.should_fail("head"):
+            raise IOError("unreliable consensus: injected failure in head")
+        return self.inner.head(key)
+
+    def compare_and_set(self, key, expected_seqno, data):
+        if self.should_fail("cas"):
+            raise IOError("unreliable consensus: injected failure in cas")
+        return self.inner.compare_and_set(key, expected_seqno, data)

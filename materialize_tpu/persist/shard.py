@@ -1,0 +1,242 @@
+"""Persist shards: durable (data, time, diff) collections.
+
+The analogue of the reference's persist-client `Machine`
+(src/persist-client/src/internal/machine.rs:61): shard state (since/upper +
+batch manifest) lives in a Consensus register, immutable batch payloads live
+in Blob, and `compare_and_append` (machine.rs:321) is a CAS loop that makes
+exactly one writer win each upper advancement — the engine's definite-
+collection / fencing primitive. Batch payloads are columnar (np.savez of the
+host mirror of an UpdateBatch), matching the engine's columnar device layout
+rather than a row codec.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import uuid
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from .location import Blob, Consensus
+
+
+class UpperMismatch(Exception):
+    """compare_and_append lost: expected upper didn't match (another writer
+    advanced the shard, or this writer is fenced)."""
+
+    def __init__(self, expected: int, actual: int):
+        super().__init__(f"expected upper {expected}, found {actual}")
+        self.actual = actual
+
+
+@dataclass
+class HollowBatch:
+    """Manifest entry: payload key + [lower, upper) + row count."""
+
+    key: str
+    lower: int
+    upper: int
+    count: int
+
+
+@dataclass
+class ShardState:
+    since: int = 0
+    upper: int = 0
+    batches: list = field(default_factory=list)  # list[HollowBatch]
+
+    def encode(self) -> bytes:
+        return json.dumps(
+            {
+                "since": self.since,
+                "upper": self.upper,
+                "batches": [
+                    [b.key, b.lower, b.upper, b.count] for b in self.batches
+                ],
+            }
+        ).encode()
+
+    @staticmethod
+    def decode(data: bytes) -> "ShardState":
+        doc = json.loads(data)
+        return ShardState(
+            since=doc["since"],
+            upper=doc["upper"],
+            batches=[HollowBatch(*b) for b in doc["batches"]],
+        )
+
+
+def encode_columns(cols: dict) -> bytes:
+    buf = io.BytesIO()
+    np.savez_compressed(buf, **cols)
+    return buf.getvalue()
+
+
+def decode_columns(data: bytes) -> dict:
+    return dict(np.load(io.BytesIO(data), allow_pickle=False))
+
+
+class ShardMachine:
+    """One shard's state machine over Blob + Consensus."""
+
+    def __init__(self, blob: Blob, consensus: Consensus, shard_id: str):
+        self.blob = blob
+        self.consensus = consensus
+        self.shard_id = shard_id
+        self._key = f"shard/{shard_id}"
+
+    # -- state ----------------------------------------------------------------
+    def fetch_state(self) -> tuple[Optional[int], ShardState]:
+        head = self.consensus.head(self._key)
+        if head is None:
+            return None, ShardState()
+        return head.seqno, ShardState.decode(head.data)
+
+    def upper(self) -> int:
+        return self.fetch_state()[1].upper
+
+    def since(self) -> int:
+        return self.fetch_state()[1].since
+
+    # -- writes ---------------------------------------------------------------
+    def compare_and_append(
+        self, cols: dict, lower: int, upper: int, max_retries: int = 8
+    ) -> None:
+        """Append columns covering [lower, upper); CAS the manifest.
+
+        cols: {'times': u64[n], 'diffs': i64[n], 'c0': …} host arrays; may be
+        empty (a pure upper advancement).
+        """
+        if upper <= lower:
+            raise ValueError(f"upper {upper} must exceed lower {lower}")
+        n = int(len(cols["times"])) if "times" in cols else 0
+        payload_key = None
+        if n:
+            payload_key = f"batch/{self.shard_id}/{uuid.uuid4().hex}"
+            self.blob.set(payload_key, encode_columns(cols))
+        for _ in range(max_retries):
+            seqno, state = self.fetch_state()
+            if state.upper != lower:
+                raise UpperMismatch(lower, state.upper)
+            new = ShardState(
+                since=state.since,
+                upper=upper,
+                batches=list(state.batches)
+                + ([HollowBatch(payload_key, lower, upper, n)] if n else []),
+            )
+            if self.consensus.compare_and_set(self._key, seqno, new.encode()):
+                return
+        raise RuntimeError("compare_and_append: CAS contention exhausted retries")
+
+    # -- reads ----------------------------------------------------------------
+    def snapshot(self, as_of: int) -> list[dict]:
+        """All batch payloads at times ≤ as_of (caller advances/consolidates).
+
+        Requires since ≤ as_of < upper for a definite answer.
+        """
+        _seq, state = self.fetch_state()
+        if as_of < state.since:
+            raise ValueError(f"as_of {as_of} < since {state.since}")
+        if as_of >= state.upper:
+            raise ValueError(f"as_of {as_of} not yet complete (upper {state.upper})")
+        out = []
+        for b in state.batches:
+            if b.count and b.lower <= as_of:
+                payload = self.blob.get(b.key)
+                if payload is None:
+                    raise IOError(f"missing blob {b.key}")
+                cols = decode_columns(payload)
+                mask = cols["times"] <= np.uint64(as_of)
+                if mask.all():
+                    out.append(cols)
+                elif mask.any():
+                    out.append({k: v[mask] for k, v in cols.items()})
+        return out
+
+    def listen_from(self, frontier: int) -> tuple[list[dict], int]:
+        """Batches with times in [frontier, upper); returns (payloads, upper)."""
+        _seq, state = self.fetch_state()
+        out = []
+        for b in state.batches:
+            if b.count and b.upper > frontier:
+                payload = self.blob.get(b.key)
+                cols = decode_columns(payload)
+                mask = cols["times"] >= np.uint64(frontier)
+                if mask.any():
+                    out.append({k: (v[mask] if not mask.all() else v) for k, v in cols.items()})
+        return out, state.upper
+
+    # -- maintenance -----------------------------------------------------------
+    def downgrade_since(self, since: int, max_retries: int = 8) -> None:
+        for _ in range(max_retries):
+            seqno, state = self.fetch_state()
+            new = ShardState(
+                since=max(state.since, since), upper=state.upper, batches=state.batches
+            )
+            if self.consensus.compare_and_set(self._key, seqno, new.encode()):
+                return
+        raise RuntimeError("downgrade_since: CAS contention")
+
+    def compact(self, max_retries: int = 8) -> None:
+        """Merge all batches ≤ since into one consolidated batch (reference:
+        persist compaction, internal/compact.rs — simplified single pass)."""
+        seqno, state = self.fetch_state()
+        mergeable = [b for b in state.batches if b.count]
+        if len(mergeable) <= 1:
+            return
+        all_cols: dict[str, list] = {}
+        for b in mergeable:
+            cols = decode_columns(self.blob.get(b.key))
+            cols["times"] = np.maximum(cols["times"], np.uint64(state.since))
+            for k, v in cols.items():
+                all_cols.setdefault(k, []).append(v)
+        merged = {k: np.concatenate(vs) for k, vs in all_cols.items()}
+        merged = _consolidate_host(merged)
+        lower = min(b.lower for b in mergeable)
+        upper = max(b.upper for b in mergeable)
+        n = len(merged["times"])
+        new_key = f"batch/{self.shard_id}/{uuid.uuid4().hex}"
+        if n:
+            self.blob.set(new_key, encode_columns(merged))
+        keep = [b for b in state.batches if not b.count]
+        new_state = ShardState(
+            since=state.since,
+            upper=state.upper,
+            batches=keep + ([HollowBatch(new_key, lower, upper, n)] if n else []),
+        )
+        for _ in range(max_retries):
+            if self.consensus.compare_and_set(self._key, seqno, new_state.encode()):
+                for b in mergeable:
+                    self.blob.delete(b.key)
+                return
+            seqno, state = self.fetch_state()
+        raise RuntimeError("compact: CAS contention")
+
+
+def _consolidate_host(cols: dict) -> dict:
+    """Host-side consolidation of columnar updates (NumPy oracle semantics)."""
+    data_keys = sorted(k for k in cols if k not in ("times", "diffs"))
+    arrays = [cols[k] for k in data_keys] + [cols["times"]]
+    order = np.lexsort(tuple(reversed(arrays)))
+    acc: dict = {}
+    times = cols["times"]
+    diffs = cols["diffs"]
+    for i in order:
+        key = tuple(cols[k][i].item() for k in data_keys) + (times[i].item(),)
+        acc[key] = acc.get(key, 0) + int(diffs[i])
+    rows = [(k, d) for k, d in acc.items() if d != 0]
+    n = len(rows)
+    out = {
+        k: np.empty(n, dtype=cols[k].dtype) for k in data_keys
+    }
+    out["times"] = np.empty(n, dtype=np.uint64)
+    out["diffs"] = np.empty(n, dtype=np.int64)
+    for i, (key, d) in enumerate(rows):
+        for j, k in enumerate(data_keys):
+            out[k][i] = key[j]
+        out["times"][i] = key[-1]
+        out["diffs"][i] = d
+    return out

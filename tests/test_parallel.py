@@ -62,8 +62,10 @@ def test_route_and_exchange_roundtrip():
 
 @pytest.mark.parametrize("n_shards", [1, 4])
 def test_fused_q3_matches_oracle(n_shards):
-    caps = Q3Caps(cust=1 << 10, orders=1 << 10, lineitem=1 << 11, delta=1 << 8,
-                  bucket=1 << 8, join_out=1 << 10, groups=1 << 10)
+    # delta sized so tick-based hydration fits in L0 (= 4*delta per shard)
+    delta = 1 << 10 if n_shards == 1 else 1 << 8
+    caps = Q3Caps(cust=1 << 10, orders=1 << 10, lineitem=1 << 12, delta=delta,
+                  bucket=1 << 9, join_out=1 << 12, groups=1 << 11)
     gen = TpchGenerator(sf=0.0005, seed=11)
     init = gen.initial_batches(1)
 

@@ -1,0 +1,110 @@
+"""Persist layer: CAS semantics, snapshots, fencing, compaction, durability."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.persist import (
+    FileBlob,
+    FileConsensus,
+    MemBlob,
+    MemConsensus,
+    ShardMachine,
+    UnreliableBlob,
+    UpperMismatch,
+)
+
+
+def cols(data, times, diffs):
+    return {
+        "c0": np.asarray(data, dtype=np.int64),
+        "times": np.asarray(times, dtype=np.uint64),
+        "diffs": np.asarray(diffs, dtype=np.int64),
+    }
+
+
+def mkshard(tmp_path=None):
+    if tmp_path is None:
+        return ShardMachine(MemBlob(), MemConsensus(), "s1")
+    return ShardMachine(
+        FileBlob(str(tmp_path / "blob")), FileConsensus(str(tmp_path / "cas")), "s1"
+    )
+
+
+def test_append_and_snapshot():
+    m = mkshard()
+    m.compare_and_append(cols([1, 2], [0, 0], [1, 1]), 0, 1)
+    m.compare_and_append(cols([1], [1], [-1]), 1, 2)
+    snaps = m.snapshot(1)
+    total = {}
+    for c in snaps:
+        for v, t, d in zip(c["c0"], c["times"], c["diffs"]):
+            total[int(v)] = total.get(int(v), 0) + int(d)
+    assert {k: v for k, v in total.items() if v} == {2: 1}
+    assert m.upper() == 2
+
+
+def test_upper_mismatch_fences_stale_writer():
+    m = mkshard()
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    with pytest.raises(UpperMismatch):
+        m.compare_and_append(cols([2], [0], [1]), 0, 1)  # stale lower
+
+
+def test_empty_advance():
+    m = mkshard()
+    m.compare_and_append({"times": np.array([], dtype=np.uint64)}, 0, 5)
+    assert m.upper() == 5
+    assert m.snapshot(3) == []
+
+
+def test_snapshot_bounds():
+    m = mkshard()
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    m.downgrade_since(1)
+    with pytest.raises(ValueError):
+        m.snapshot(0)  # below since
+    with pytest.raises(ValueError):
+        m.snapshot(5)  # not yet complete
+
+
+def test_file_backed_durability(tmp_path):
+    m = mkshard(tmp_path)
+    m.compare_and_append(cols([7, 8], [0, 0], [1, 1]), 0, 1)
+    # "restart": fresh machine over the same files
+    m2 = mkshard(tmp_path)
+    assert m2.upper() == 1
+    snaps = m2.snapshot(0)
+    assert sorted(int(v) for c in snaps for v in c["c0"]) == [7, 8]
+
+
+def test_compaction_consolidates():
+    m = mkshard()
+    m.compare_and_append(cols([1, 2], [0, 0], [1, 1]), 0, 1)
+    m.compare_and_append(cols([1], [1], [-1]), 1, 2)
+    m.downgrade_since(1)
+    m.compact()
+    _seq, state = m.fetch_state()
+    assert len([b for b in state.batches if b.count]) == 1
+    snaps = m.snapshot(1)
+    assert len(snaps) == 1
+    assert snaps[0]["c0"].tolist() == [2]
+
+
+def test_listen_from():
+    m = mkshard()
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    m.compare_and_append(cols([2], [1], [1]), 1, 2)
+    batches, upper = m.listen_from(1)
+    assert upper == 2
+    assert [int(v) for c in batches for v in c["c0"]] == [2]
+
+
+def test_unreliable_blob_fails_then_recovers():
+    fail = {"on": True}
+    blob = UnreliableBlob(MemBlob(), lambda op: fail["on"] and op == "set")
+    m = ShardMachine(blob, MemConsensus(), "s1")
+    with pytest.raises(IOError):
+        m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    fail["on"] = False
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)  # same lower: state unchanged
+    assert m.upper() == 1
