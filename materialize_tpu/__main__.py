@@ -1,0 +1,104 @@
+"""CLI: `python -m materialize_tpu serve|sql` — the environmentd/psql analogue.
+
+  serve --port 6875 [--data-dir DIR] [--advance-every SECS [--rows N]]
+      Start the HTTP SQL frontend (POST /api/sql). With --advance-every,
+      load-generator sources tick continuously.
+  sql [--url http://127.0.0.1:6875]
+      Interactive SQL shell against a running server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.request
+
+
+def cmd_serve(args) -> None:
+    from .adapter import Coordinator
+    from .frontend import serve
+
+    coord = Coordinator(data_dir=args.data_dir)
+    httpd = serve(coord, host=args.host, port=args.port)
+    print(f"materialize_tpu listening on http://{args.host}:{args.port}", flush=True)
+    if args.advance_every > 0:
+        def ticker():
+            while True:
+                time.sleep(args.advance_every)
+                try:
+                    with httpd.RequestHandlerClass.lock:
+                        coord.advance(args.rows)
+                except Exception as e:  # keep serving
+                    print(f"advance error: {e}", file=sys.stderr)
+
+        threading.Thread(target=ticker, daemon=True).start()
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        coord.checkpoint() if coord.durable else None
+        httpd.shutdown()
+
+
+def cmd_sql(args) -> None:
+    def run(q: str):
+        req = urllib.request.Request(
+            f"{args.url}/api/sql",
+            data=json.dumps({"query": q}).encode(),
+            headers={"content-type": "application/json"},
+        )
+        with urllib.request.urlopen(req) as resp:
+            return json.loads(resp.read())
+
+    print("materialize_tpu SQL shell — \\q to quit")
+    buf = ""
+    while True:
+        try:
+            prompt = "mzt> " if not buf else "   > "
+            line = input(prompt)
+        except EOFError:
+            break
+        if line.strip() in ("\\q", "quit", "exit"):
+            break
+        buf += " " + line
+        if not line.rstrip().endswith(";"):
+            continue
+        try:
+            doc = run(buf)
+            for res in doc.get("results", []):
+                if "rows" in res:
+                    print("  ".join(res["col_names"]))
+                    print("-" * 40)
+                    for row in res["rows"]:
+                        print("  ".join(str(v) for v in row))
+                    print(f"({len(res['rows'])} rows)")
+                else:
+                    print(res.get("ok", "ok"))
+            if "error" in doc:
+                print(f"ERROR: {doc['error']}")
+        except Exception as e:
+            print(f"ERROR: {e}")
+        buf = ""
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="materialize_tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    s = sub.add_parser("serve")
+    s.add_argument("--host", default="127.0.0.1")
+    s.add_argument("--port", type=int, default=6875)
+    s.add_argument("--data-dir", default=None)
+    s.add_argument("--advance-every", type=float, default=0.0)
+    s.add_argument("--rows", type=int, default=100)
+    s.set_defaults(fn=cmd_serve)
+    q = sub.add_parser("sql")
+    q.add_argument("--url", default="http://127.0.0.1:6875")
+    q.set_defaults(fn=cmd_sql)
+    args = p.parse_args()
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -87,8 +87,17 @@ class Coordinator:
             self.blob = FileBlob(f"{data_dir}/blob")
             self.consensus = FileConsensus(f"{data_dir}/consensus")
         self.shards: dict[str, object] = {}  # gid -> ShardMachine
+        self._register_introspection()
         if self.durable:
             self._boot()
+
+    def _register_introspection(self) -> None:
+        from .introspection import INTROSPECTION_TABLES, IntrospectionCollection
+
+        for name, desc in INTROSPECTION_TABLES.items():
+            item = CatalogItem(name, "introspection", desc=desc, global_id=f"si_{name}")
+            self.catalog.items[name] = item
+            self.storage[item.global_id] = IntrospectionCollection(self, name, desc)
 
     @property
     def durable(self) -> bool:
@@ -125,7 +134,53 @@ class Coordinator:
             return self._show(stmt)
         if isinstance(stmt, ast.DropObject):
             return self._drop(stmt)
+        if isinstance(stmt, ast.Subscribe):
+            return self._subscribe(stmt)
         raise PlanError(f"unsupported statement: {type(stmt).__name__}")
+
+    # -- subscriptions ---------------------------------------------------------
+    def _subscribe(self, stmt: ast.Subscribe) -> ExecResult:
+        """SUBSCRIBE: stream a collection's update triples (reference:
+        src/compute/src/sink/subscribe.rs). Returns a subscription id; poll
+        with `poll_subscription` for (data…, ts, diff) deltas."""
+        pq = self.planner.plan_query(stmt.query)
+        rel = optimize(pq.mir)
+        if isinstance(rel, mir.MirGet) and any(
+            g == rel.id for g, _df, _s in self.dataflows
+        ) or (isinstance(rel, mir.MirGet) and rel.id in self.storage):
+            gid = rel.id
+        else:
+            # materialize the query under a hidden name, then tail it
+            n = len(getattr(self, "subscriptions", {}))
+            name = f"_sub_{n}"
+            self.execute_stmt(
+                ast.CreateMaterializedView(name, stmt.query)
+            )
+            gid = self.catalog.get(name).global_id
+        if not hasattr(self, "subscriptions"):
+            self.subscriptions = {}
+        sub_id = f"sub{len(self.subscriptions)}"
+        self.subscriptions[sub_id] = {
+            "gid": gid,
+            "frontier": 0,
+            "pq": pq,
+        }
+        return ExecResult("status", status=sub_id)
+
+    def poll_subscription(self, sub_id: str):
+        """New updates since the last poll: ([(data…, ts, diff)], frontier)."""
+        sub = self.subscriptions[sub_id]
+        store = self.storage[sub["gid"]]
+        frontier = sub["frontier"]
+        upper = store.upper
+        rows = []
+        if upper > frontier and store.arr.batches:
+            for data, t, d in store.arr.merged().to_rows():
+                if frontier <= t < upper:
+                    rows.append((self._decode_row(data, sub["pq"]), int(t), int(d)))
+        sub["frontier"] = upper
+        rows.sort(key=lambda r: (r[1], r[0]))
+        return rows, upper
 
     # -- DDL -------------------------------------------------------------------
     def _create_table(self, stmt: ast.CreateTable) -> ExecResult:
@@ -371,6 +426,8 @@ class Coordinator:
 
         items = []
         for it in self.catalog.items.values():
+            if it.kind == "introspection":
+                continue
             items.append(
                 {
                     "name": it.name,

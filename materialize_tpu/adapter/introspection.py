@@ -1,0 +1,136 @@
+"""Builtin introspection relations (the mz_internal analogue).
+
+The reference surfaces engine internals as queryable relations built from
+logging dataflows (src/compute/src/logging, src/catalog/src/builtin.rs —
+mz_tables, mz_arrangement_sizes, mz_scheduling_elapsed, …). Here the same
+names resolve to virtual collections whose contents are computed from the
+live coordinator at peek time — same SQL surface, host-computed snapshot.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..repr.batch import UpdateBatch
+from ..repr.types import ColType, RelationDesc
+
+
+def _desc(*cols) -> RelationDesc:
+    return RelationDesc.of(*cols)
+
+
+INTROSPECTION_TABLES = {
+    "mz_tables": _desc(("id", ColType.STRING), ("name", ColType.STRING)),
+    "mz_views": _desc(("id", ColType.STRING), ("name", ColType.STRING)),
+    "mz_materialized_views": _desc(("id", ColType.STRING), ("name", ColType.STRING)),
+    "mz_sources": _desc(("id", ColType.STRING), ("name", ColType.STRING)),
+    "mz_indexes": _desc(
+        ("id", ColType.STRING), ("name", ColType.STRING), ("on_name", ColType.STRING)
+    ),
+    "mz_columns": _desc(
+        ("object_name", ColType.STRING),
+        ("name", ColType.STRING),
+        ("position", ColType.INT64),
+        ("type", ColType.STRING),
+    ),
+    "mz_dataflows": _desc(("id", ColType.STRING), ("name", ColType.STRING)),
+    "mz_dataflow_operators": _desc(
+        ("dataflow", ColType.STRING),
+        ("operator_id", ColType.INT64),
+        ("operator_type", ColType.STRING),
+    ),
+    "mz_scheduling_elapsed": _desc(
+        ("dataflow", ColType.STRING),
+        ("operator_id", ColType.INT64),
+        ("operator_type", ColType.STRING),
+        ("elapsed_ns", ColType.INT64),
+        ("invocations", ColType.INT64),
+    ),
+    "mz_arrangement_sizes": _desc(
+        ("dataflow", ColType.STRING),
+        ("operator_id", ColType.INT64),
+        ("arrangement", ColType.STRING),
+        ("batches", ColType.INT64),
+        ("capacity", ColType.INT64),
+        ("records", ColType.INT64),
+    ),
+}
+
+
+def introspection_rows(coord, name: str) -> list[tuple]:
+    """Current contents of one introspection relation (python values; strings
+    stay python str — encoded by the virtual collection)."""
+    cat = coord.catalog
+    if name in ("mz_tables", "mz_views", "mz_materialized_views", "mz_sources"):
+        kind = {
+            "mz_tables": "table",
+            "mz_views": "view",
+            "mz_materialized_views": "materialized_view",
+            "mz_sources": "source",
+        }[name]
+        return [
+            (i.global_id, i.name) for i in cat.items.values() if i.kind == kind
+        ]
+    if name == "mz_indexes":
+        return [
+            (i.global_id, i.name, i.index_on or "")
+            for i in cat.items.values()
+            if i.kind == "index"
+        ]
+    if name == "mz_columns":
+        out = []
+        for it in cat.items.values():
+            if it.desc is None:
+                continue
+            for pos, c in enumerate(it.desc.columns):
+                out.append((it.name, c.name, pos, c.typ.value))
+        return out
+    if name == "mz_dataflows":
+        gid2name = {i.global_id: i.name for i in cat.items.values()}
+        return [(gid, gid2name.get(gid, gid)) for gid, _df, _src in coord.dataflows]
+    if name == "mz_dataflow_operators":
+        out = []
+        for gid, df, _src in coord.dataflows:
+            for obj, op_i, typ, _el, _inv in df.operator_info():
+                out.append((gid, op_i, typ))
+        return out
+    if name == "mz_scheduling_elapsed":
+        out = []
+        for gid, df, _src in coord.dataflows:
+            for obj, op_i, typ, el, inv in df.operator_info():
+                out.append((gid, op_i, typ, el, inv))
+        return out
+    if name == "mz_arrangement_sizes":
+        out = []
+        for gid, df, _src in coord.dataflows:
+            for obj, op_i, aname, nb, cap, rec in df.arrangement_info():
+                out.append((gid, op_i, aname, nb, cap, rec))
+        return out
+    raise ValueError(f"unknown introspection relation {name}")
+
+
+class IntrospectionCollection:
+    """StorageCollection-shaped adapter over introspection_rows."""
+
+    def __init__(self, coord, name: str, desc: RelationDesc):
+        self.coord = coord
+        self.name = name
+        self.desc = desc
+        self.dtypes = desc.dtypes
+
+    def snapshot(self, as_of: int) -> UpdateBatch:
+        rows = introspection_rows(self.coord, self.name)
+        cols: list[list] = [[] for _ in self.desc.columns]
+        for r in rows:
+            for i, v in enumerate(r):
+                if self.desc.columns[i].typ == ColType.STRING:
+                    v = self.coord.catalog.dict.encode(str(v))
+                cols[i].append(v)
+        n = len(rows)
+        arrays = tuple(
+            np.array(c, dtype=self.desc.columns[i].dtype)
+            for i, c in enumerate(cols)
+        )
+        return UpdateBatch.build(
+            (), arrays, np.full(n, as_of, dtype=np.uint64), np.ones(n, dtype=np.int64)
+        )

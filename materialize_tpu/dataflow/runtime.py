@@ -62,6 +62,14 @@ class Node:
     def compact(self, since: int) -> None:
         pass
 
+    def state_info(self) -> list:
+        """Introspection: [(arrangement name, n_batches, capacity, records)].
+
+        The analogue of the reference's mz_arrangement_sizes logging
+        (src/compute/src/logging, doc/developer/arrangements.md:34).
+        """
+        return []
+
 
 class ConstantNode(Node):
     def __init__(self, expr: lir.Constant):
@@ -136,6 +144,9 @@ class ArrangeByNode(Node):
     def compact(self, since):
         self.arr.compact(since)
 
+    def state_info(self):
+        return [("arrange_by", len(self.arr.batches), self.arr.total_cap(), self.arr.count())]
+
 
 class LinearJoinNode(Node):
     """Binary join chain; each stage keeps arrangements of both sides
@@ -184,6 +195,13 @@ class LinearJoinNode(Node):
         for l, r in self.state:
             l.compact(since)
             r.compact(since)
+
+    def state_info(self):
+        out = []
+        for i, (l, r) in enumerate(self.state):
+            out.append((f"join_stage{i}_left", len(l.batches), l.total_cap(), l.count()))
+            out.append((f"join_stage{i}_right", len(r.batches), r.total_cap(), r.count()))
+        return out
 
 
 class DeltaJoinNode(Node):
@@ -237,6 +255,12 @@ class DeltaJoinNode(Node):
         for arr in self.arrs.values():
             arr.compact(since)
 
+    def state_info(self):
+        return [
+            (f"delta_in{inp}_key{list(key)}", len(a.batches), a.total_cap(), a.count())
+            for (inp, key), a in self.arrs.items()
+        ]
+
 
 class ReduceNode(Node):
     def __init__(self, expr: lir.Reduce, in_dtypes: tuple):
@@ -260,6 +284,9 @@ class ReduceNode(Node):
         if bucket_cap(n) < self.state.cap:
             self.state = self.state.with_capacity(bucket_cap(n))
         return out, _union([errs, agg_errs])
+
+    def state_info(self):
+        return [("reduce_accums", 1, self.state.cap, int(self.state.count()))]
 
 
 class DistinctNode(Node):
@@ -354,6 +381,35 @@ class Dataflow:
             self.index_errs[idx_id] = Arrangement(key_cols=())
         self.sink_outputs: dict[str, list] = {s: [] for s in desc.sink_exports}
         self.frontier = desc.as_of
+        # (obj_id, op_idx) -> {type, elapsed_ns, invocations}; the analogue of
+        # the reference's timely/compute introspection logs (SURVEY.md §5)
+        self.metrics: dict = {}
+
+    def operator_info(self) -> list:
+        """[(obj_id, op_idx, type, elapsed_ns, invocations)] per operator."""
+        out = []
+        for obj_id, ops, _ref in self.builds:
+            for op_i, (node, _ins) in enumerate(ops):
+                m = self.metrics.get((obj_id, op_i), {})
+                out.append(
+                    (
+                        obj_id,
+                        op_i,
+                        type(node).__name__,
+                        m.get("elapsed_ns", 0),
+                        m.get("invocations", 0),
+                    )
+                )
+        return out
+
+    def arrangement_info(self) -> list:
+        """[(obj_id, op_idx, name, batches, capacity, records)]."""
+        out = []
+        for obj_id, ops, _ref in self.builds:
+            for op_i, (node, _ins) in enumerate(ops):
+                for name, nb, cap, rec in node.state_info():
+                    out.append((obj_id, op_i, name, nb, cap, int(rec)))
+        return out
 
     # -- rendering ---------------------------------------------------------
     def _render(self, expr, ops: list):
@@ -452,17 +508,26 @@ class Dataflow:
 
         Returns {exported id: (oks delta, errs delta) or None}.
         """
+        import time as _time
+
         env: dict[str, Delta] = {}
         for sid, batch in source_deltas.items():
             env[sid] = (batch, None)
         results: dict[str, Delta] = {}
         for obj_id, ops, out_ref in self.builds:
             slots: list[Delta] = []
-            for node, in_refs in ops:
+            for op_i, (node, in_refs) in enumerate(ops):
                 ins = [
                     (env.get(r) if isinstance(r, str) else slots[r]) for r in in_refs
                 ]
+                t0 = _time.perf_counter_ns()
                 slots.append(node.step(tick, ins))
+                m = self.metrics.setdefault(
+                    (obj_id, op_i),
+                    {"type": type(node).__name__, "elapsed_ns": 0, "invocations": 0},
+                )
+                m["elapsed_ns"] += _time.perf_counter_ns() - t0
+                m["invocations"] += 1
             out = env.get(out_ref) if isinstance(out_ref, str) else slots[out_ref]
             env[obj_id] = out
             results[obj_id] = out

@@ -1,0 +1,146 @@
+"""HTTP SQL frontend — the environmentd HTTP API analogue.
+
+The reference serves SQL over HTTP/WS next to pgwire
+(src/environmentd/src/http). This server exposes:
+
+  POST /api/sql          {"query": "stmt; stmt; …"}  → {"results": […]}
+  POST /api/subscribe    {"query": "SELECT …"}        → {"subscription_id": …}
+  GET  /api/subscribe/<id>/poll                       → {"updates": […], "frontier": N}
+  GET  /api/readyz                                    → "ok"
+  GET  /metrics                                       → Prometheus text format
+
+Commands are serialized through a lock, preserving the reference's
+single-threaded coordinator command loop semantics (coord.rs:3822).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..adapter import Coordinator
+
+
+def _json_default(v):
+    import numpy as np
+
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    raise TypeError(f"not serializable: {type(v)}")
+
+
+class SqlHandler(BaseHTTPRequestHandler):
+    coordinator: Coordinator = None
+    lock: threading.Lock = None
+
+    def log_message(self, fmt, *args):  # quiet by default
+        pass
+
+    def _reply(self, code: int, body, content_type="application/json"):
+        data = (
+            body.encode()
+            if isinstance(body, str)
+            else json.dumps(body, default=_json_default).encode()
+        )
+        self.send_response(code)
+        self.send_header("content-type", content_type)
+        self.send_header("content-length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _read_body(self) -> dict:
+        n = int(self.headers.get("content-length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        return json.loads(raw)
+
+    def do_GET(self):
+        if self.path == "/api/readyz":
+            return self._reply(200, "ok", "text/plain")
+        if self.path == "/metrics":
+            return self._reply(200, self._metrics_text(), "text/plain")
+        if self.path.startswith("/api/subscribe/") and self.path.endswith("/poll"):
+            sub_id = self.path.split("/")[3]
+            with self.lock:
+                try:
+                    rows, frontier = self.coordinator.poll_subscription(sub_id)
+                except KeyError:
+                    return self._reply(404, {"error": f"unknown subscription {sub_id}"})
+            updates = [
+                {"row": list(data), "timestamp": ts, "diff": d} for data, ts, d in rows
+            ]
+            return self._reply(200, {"updates": updates, "frontier": frontier})
+        return self._reply(404, {"error": "not found"})
+
+    def do_POST(self):
+        if self.path == "/api/sql":
+            try:
+                doc = self._read_body()
+                sql = doc.get("query", "")
+                with self.lock:
+                    results = self.coordinator.execute_script(sql)
+                out = []
+                for r in results:
+                    if r.kind == "rows":
+                        out.append(
+                            {
+                                "rows": [list(row) for row in r.rows],
+                                "col_names": list(r.columns),
+                            }
+                        )
+                    else:
+                        out.append({"ok": r.status})
+                return self._reply(200, {"results": out})
+            except Exception as e:
+                return self._reply(400, {"error": str(e)})
+        if self.path == "/api/subscribe":
+            try:
+                doc = self._read_body()
+                with self.lock:
+                    r = self.coordinator.execute(doc.get("query", ""))
+                return self._reply(200, {"subscription_id": r.status})
+            except Exception as e:
+                return self._reply(400, {"error": str(e)})
+        return self._reply(404, {"error": "not found"})
+
+    def _metrics_text(self) -> str:
+        """Prometheus text exposition of coordinator/dataflow metrics
+        (reference: mz_ore::metrics registries, src/compute/src/metrics.rs)."""
+        c = self.coordinator
+        lines = [
+            "# TYPE mzt_oracle_read_ts gauge",
+            f"mzt_oracle_read_ts {c.oracle.read_ts()}",
+            "# TYPE mzt_catalog_items gauge",
+            f"mzt_catalog_items {len(c.catalog.items)}",
+            "# TYPE mzt_dataflows gauge",
+            f"mzt_dataflows {len(c.dataflows)}",
+            "# TYPE mzt_operator_elapsed_ns counter",
+        ]
+        with self.lock:
+            for gid, df, _src in c.dataflows:
+                for _obj, op_i, typ, el, inv in df.operator_info():
+                    lines.append(
+                        f'mzt_operator_elapsed_ns{{dataflow="{gid}",op="{op_i}",type="{typ}"}} {el}'
+                    )
+            for gid, df, _src in c.dataflows:
+                for _obj, op_i, name, nb, cap, rec in df.arrangement_info():
+                    lines.append(
+                        f'mzt_arrangement_records{{dataflow="{gid}",op="{op_i}",arrangement="{name}"}} {rec}'
+                    )
+        return "\n".join(lines) + "\n"
+
+
+def serve(
+    coordinator: Coordinator, host: str = "127.0.0.1", port: int = 6875
+) -> ThreadingHTTPServer:
+    """Start the HTTP frontend (returns the server; call serve_forever or
+    shutdown from the caller)."""
+    handler = type(
+        "BoundSqlHandler",
+        (SqlHandler,),
+        {"coordinator": coordinator, "lock": threading.Lock()},
+    )
+    httpd = ThreadingHTTPServer((host, port), handler)
+    return httpd

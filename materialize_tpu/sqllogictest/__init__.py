@@ -1,0 +1,3 @@
+from .runner import SltResult, run_slt_file, run_slt_text
+
+__all__ = ["SltResult", "run_slt_file", "run_slt_text"]

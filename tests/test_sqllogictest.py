@@ -1,0 +1,37 @@
+"""Drive every .slt file under test/sqllogictest/ through the runner."""
+
+import glob
+import os
+
+import pytest
+
+from materialize_tpu.sqllogictest import run_slt_file
+
+SLT_DIR = os.path.join(os.path.dirname(__file__), "..", "test", "sqllogictest")
+FILES = sorted(glob.glob(os.path.join(SLT_DIR, "*.slt")))
+
+
+@pytest.mark.parametrize("path", FILES, ids=[os.path.basename(f) for f in FILES])
+def test_slt(path):
+    res = run_slt_file(path)
+    assert res.ok(), "\n".join(res.errors)
+    assert res.passed > 0
+
+
+def test_runner_detects_mismatch():
+    from materialize_tpu.sqllogictest import run_slt_text
+
+    bad = """
+statement ok
+CREATE TABLE t (a int)
+
+statement ok
+INSERT INTO t VALUES (1)
+
+query I
+SELECT a FROM t
+----
+2
+"""
+    res = run_slt_text(bad)
+    assert res.failed == 1
