@@ -1,0 +1,4 @@
+from . import protocol
+from .controller import ComputeController, ReplicaClient
+
+__all__ = ["protocol", "ComputeController", "ReplicaClient"]

@@ -1,0 +1,209 @@
+"""clusterd — the cluster worker binary.
+
+The analogue of the reference's `clusterd` (src/clusterd/src/bin/clusterd.rs):
+a stateless process that listens for a controller connection, renders
+dataflows it is told to build (src/compute/src/compute_state.rs:516
+handle_compute_command), pulls source data from persist shards (never from
+the controller), answers peeks, and reports frontiers. Restart + reconnect is
+safe because the controller replays its command history (reconciliation) and
+all inputs re-hydrate from shards.
+"""
+
+from __future__ import annotations
+
+import argparse
+import socket
+import sys
+import threading
+
+import numpy as np
+
+from ..dataflow import Dataflow
+from ..persist import FileBlob, FileConsensus, ShardMachine
+from ..repr.batch import UpdateBatch
+from . import protocol as p
+
+
+class ClusterState:
+    def __init__(self) -> None:
+        self.blob = None
+        self.consensus = None
+        self.epoch = -1
+        # dataflow_id -> dict(df, source_shards, frontier)
+        self.dataflows: dict[str, dict] = {}
+
+    # -- command handlers (compute_state.rs:516 analogue) ---------------------
+    def handle(self, cmd):
+        if isinstance(cmd, p.Hello):
+            if cmd.epoch < self.epoch:
+                return p.CommandErr(f"fenced: stale epoch {cmd.epoch} < {self.epoch}")
+            self.epoch = cmd.epoch
+            return p.Pong(self.epoch)
+        if isinstance(cmd, p.Ping):
+            return p.Pong(self.epoch)
+        if isinstance(cmd, p.CreateInstance):
+            self.blob = FileBlob(cmd.blob_path)
+            self.consensus = FileConsensus(cmd.consensus_path)
+            return p.Frontiers({})
+        if isinstance(cmd, p.CreateDataflow):
+            return self._create_dataflow(cmd)
+        if isinstance(cmd, p.AllowCompaction):
+            st = self.dataflows.get(cmd.dataflow_id)
+            if st is not None:
+                st["df"].compact(cmd.since)
+            return p.Frontiers(self._uppers())
+        if isinstance(cmd, p.ProcessTo):
+            return self._process_to(cmd.upper)
+        if isinstance(cmd, p.Peek):
+            return self._peek(cmd)
+        return p.CommandErr(f"unknown command {type(cmd).__name__}")
+
+    def _create_dataflow(self, cmd: p.CreateDataflow):
+        if cmd.dataflow_id in self.dataflows:
+            # reconciliation replay: already installed, keep as-is
+            return p.Frontiers(self._uppers())
+        df = Dataflow(cmd.desc)
+        st = {
+            "df": df,
+            "source_shards": dict(cmd.source_shards),
+            "frontier": cmd.as_of,
+        }
+        self.dataflows[cmd.dataflow_id] = st
+        # hydrate from shard snapshots at as_of
+        snaps = {}
+        for gid, shard_id in st["source_shards"].items():
+            m = ShardMachine(self.blob, self.consensus, shard_id)
+            _seq, state = m.fetch_state()
+            if state.batches:
+                at = max(min(cmd.as_of, state.upper - 1), state.since)
+                batches = m.snapshot(at)
+                if batches:
+                    snaps[gid] = _cols_to_batch(batches, cmd.as_of)
+        if snaps:
+            df.step(cmd.as_of, snaps)
+        st["frontier"] = cmd.as_of + 1
+        df.frontier = cmd.as_of + 1
+        return p.Frontiers(self._uppers())
+
+    def _process_to(self, upper: int):
+        """Pull new shard data and step dataflows tick by tick (the worker
+        loop: server.rs:356 analogue, driven by explicit ProcessTo)."""
+        for df_id, st in self.dataflows.items():
+            df = st["df"]
+            lo = st["frontier"]
+            if upper <= lo:
+                continue
+            # collect per-source updates in [lo, upper)
+            per_time: dict[int, dict[str, list]] = {}
+            for gid, shard_id in st["source_shards"].items():
+                m = ShardMachine(self.blob, self.consensus, shard_id)
+                batches, _shard_upper = m.listen_from(lo)
+                for cols in batches:
+                    mask = cols["times"] < np.uint64(upper)
+                    if not mask.any():
+                        continue
+                    sub = {k: v[mask] for k, v in cols.items()}
+                    for t in np.unique(sub["times"]):
+                        tmask = sub["times"] == t
+                        per_time.setdefault(int(t), {}).setdefault(gid, []).append(
+                            {k: v[tmask] for k, v in sub.items()}
+                        )
+            for t in sorted(per_time):
+                deltas = {
+                    gid: _cols_to_batch(parts, None)
+                    for gid, parts in per_time[t].items()
+                }
+                df.step(t, deltas)
+            st["frontier"] = upper
+            df.frontier = upper
+        return p.Frontiers(self._uppers())
+
+    def _peek(self, cmd: p.Peek):
+        st = self.dataflows.get(cmd.dataflow_id)
+        if st is None:
+            return p.PeekResponse(cmd.uuid, None, f"unknown dataflow {cmd.dataflow_id}")
+        try:
+            rows = st["df"].peek(cmd.index_id, at=cmd.at)
+            return p.PeekResponse(cmd.uuid, rows)
+        except Exception as e:
+            return p.PeekResponse(cmd.uuid, None, str(e))
+
+    def _uppers(self) -> dict:
+        return {k: st["frontier"] for k, st in self.dataflows.items()}
+
+
+def _cols_to_batch(col_dicts, advance_to) -> UpdateBatch:
+    parts = col_dicts if isinstance(col_dicts, list) else [col_dicts]
+    datas, times, diffs = [], [], []
+    ncols = max(
+        (len([k for k in c if k.startswith("c")]) for c in parts), default=0
+    )
+    for c in parts:
+        datas.append([c[f"c{i}"] for i in range(ncols)])
+        t = c["times"]
+        if advance_to is not None:
+            t = np.maximum(t, np.uint64(advance_to))
+        times.append(t)
+        diffs.append(c["diffs"])
+    cols = tuple(
+        np.concatenate([d[i] for d in datas]) for i in range(ncols)
+    )
+    return UpdateBatch.build(
+        (), cols, np.concatenate(times), np.concatenate(diffs)
+    )
+
+
+def serve(host: str, port: int):
+    """Listen for controller connections (thread per connection; command
+    handling is serialized by a lock — the worker loop is single-threaded as
+    in the reference, but a newer-generation controller can always get in to
+    fence the old one via its epoch)."""
+    state = ClusterState()
+    lock = threading.Lock()
+    srv = socket.create_server((host, port), reuse_port=False)
+    srv.listen(4)
+    print(f"clusterd listening on {host}:{port}", flush=True)
+
+    def client(conn):
+        try:
+            while True:
+                cmd = p.recv_frame(conn)
+                if cmd is None:
+                    break
+                with lock:
+                    resp = state.handle(cmd)
+                p.send_frame(conn, resp)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    while True:
+        conn, _addr = srv.accept()
+        threading.Thread(target=client, args=(conn,), daemon=True).start()
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(prog="clusterd")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, required=True)
+    ap.add_argument("--cpu", action="store_true", help="force CPU jax (tests)")
+    args = ap.parse_args()
+    if args.cpu:
+        import os
+
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            import jax
+            from jax._src import xla_bridge as _xb
+
+            jax.config.update("jax_platforms", "cpu")
+            for name in ("axon", "tpu"):
+                _xb._backend_factories.pop(name, None)
+        except Exception:
+            pass
+    serve(args.host, args.port)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""ComputeController: desired-state reconciliation + multi-replica fan-out.
+
+The analogue of the reference's compute controller
+(src/compute-client/src/controller.rs:180): owns the command history, fans
+commands out to every replica, replays history on replica (re)connect
+(protocol/history.rs reconciliation), merges frontier reports, and answers
+each peek from the FIRST replica that responds
+(absorb_peek_response, src/compute-client/src/service.rs:219) — replicas are
+identical and stateless, so any of them can serve (active-active HA).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+import uuid as uuidlib
+from dataclasses import dataclass, field
+
+from . import protocol as p
+
+
+class ReplicaClient:
+    """One replica connection (controller/replica.rs analogue)."""
+
+    def __init__(self, addr: tuple, epoch: int):
+        self.addr = addr
+        self.epoch = epoch
+        self.sock: socket.socket | None = None
+
+    def connect(self, timeout: float = 5.0) -> None:
+        deadline = time.time() + timeout
+        last = None
+        while time.time() < deadline:
+            try:
+                self.sock = socket.create_connection(self.addr, timeout=2.0)
+                resp = self.request(p.Hello(self.epoch))
+                if isinstance(resp, p.CommandErr):
+                    raise ConnectionError(resp.message)
+                # commands can take minutes (first XLA compile of a dataflow)
+                self.sock.settimeout(600.0)
+                return
+            except (ConnectionError, OSError) as e:
+                last = e
+                time.sleep(0.05)
+        raise ConnectionError(f"cannot reach replica {self.addr}: {last}")
+
+    def request(self, cmd):
+        p.send_frame(self.sock, cmd)
+        resp = p.recv_frame(self.sock)
+        if resp is None:
+            raise ConnectionError(f"replica {self.addr} hung up")
+        return resp
+
+    def close(self) -> None:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            self.sock = None
+
+
+class ComputeController:
+    def __init__(self, replica_addrs: list, blob_path: str, consensus_path: str, epoch: int = 0):
+        self.addrs = list(replica_addrs)
+        self.epoch = epoch
+        self.history: list = [p.CreateInstance(blob_path, consensus_path)]
+        self.replicas: list[ReplicaClient | None] = [None] * len(self.addrs)
+        self.frontier = 0
+        for i in range(len(self.addrs)):
+            self._ensure_replica(i)
+
+    # -- replica lifecycle -----------------------------------------------------
+    def _ensure_replica(self, i: int) -> ReplicaClient | None:
+        r = self.replicas[i]
+        if r is not None and r.sock is not None:
+            return r
+        r = ReplicaClient(self.addrs[i], self.epoch)
+        try:
+            r.connect()
+        except ConnectionError:
+            self.replicas[i] = None
+            return None
+        # reconciliation: replay the entire command history
+        for cmd in self.history:
+            resp = r.request(cmd)
+            if isinstance(resp, p.CommandErr):
+                r.close()
+                self.replicas[i] = None
+                return None
+        self.replicas[i] = r
+        return r
+
+    def _broadcast(self, cmd, record: bool = True):
+        """Send to every reachable replica; a dead replica is dropped (it will
+        be reconciled on reconnect)."""
+        if record:
+            self.history.append(cmd)
+        out = []
+        for i in range(len(self.addrs)):
+            r = self._ensure_replica(i)
+            if r is None:
+                out.append(None)
+                continue
+            try:
+                out.append(r.request(cmd))
+            except ConnectionError:
+                r.close()
+                self.replicas[i] = None
+                out.append(None)
+        if all(o is None for o in out):
+            raise ConnectionError("no live replicas")
+        return out
+
+    # -- public API (controller.rs:785,897 analogues) --------------------------
+    def create_dataflow(self, dataflow_id: str, desc, source_shards: dict, as_of: int):
+        self._broadcast(p.CreateDataflow(dataflow_id, desc, source_shards, as_of))
+
+    def allow_compaction(self, dataflow_id: str, since: int):
+        self._broadcast(p.AllowCompaction(dataflow_id, since))
+
+    def process_to(self, upper: int):
+        """Tell replicas to ingest shard data up to `upper`; merge frontiers."""
+        resps = self._broadcast(p.ProcessTo(upper), record=True)
+        self.frontier = upper
+        return resps
+
+    def peek(self, dataflow_id: str, index_id: str, at=None):
+        """First replica to answer wins (absorb_peek_response dedup)."""
+        uid = uuidlib.uuid4().hex
+        cmd = p.Peek(uid, dataflow_id, index_id, at)
+        last_err = None
+        for i in range(len(self.addrs)):
+            r = self._ensure_replica(i)
+            if r is None:
+                continue
+            try:
+                resp = r.request(cmd)
+            except ConnectionError:
+                r.close()
+                self.replicas[i] = None
+                continue
+            if isinstance(resp, p.PeekResponse):
+                if resp.error is None:
+                    return resp.rows
+                last_err = resp.error
+        raise RuntimeError(last_err or "no live replicas for peek")
+
+    def close(self) -> None:
+        for r in self.replicas:
+            if r is not None:
+                r.close()

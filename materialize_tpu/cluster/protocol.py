@@ -1,0 +1,130 @@
+"""CTP — the controller↔cluster transport protocol.
+
+The analogue of the reference's CTP (src/service/src/transport.rs:9-18:
+length-prefixed bincode frames with heartbeats over TCP) and of the compute
+protocol command/response enums (src/compute-client/src/protocol/command.rs:38,
+response.rs:29). Frames here are length-prefixed pickles (trusted local
+processes; a proto codec slots in for cross-version deployments).
+
+Commands:  CreateInstance, CreateDataflow, AllowCompaction, Peek, ProcessTo,
+           Hello (epoch handshake — stale generations are fenced, the
+           communication.rs:253 epoch-fencing analogue)
+Responses: Frontiers, PeekResponse, Error, Pong
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+_LEN = struct.Struct(">Q")
+
+
+def send_frame(sock: socket.socket, obj) -> None:
+    payload = pickle.dumps(obj)
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def recv_frame(sock: socket.socket):
+    header = _recv_exact(sock, _LEN.size)
+    if header is None:
+        return None
+    (n,) = _LEN.unpack(header)
+    payload = _recv_exact(sock, n)
+    if payload is None:
+        return None
+    return pickle.loads(payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+# -- commands ---------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Hello:
+    """Handshake: controller identifies itself with an epoch; a clusterd that
+    has seen a higher epoch refuses (fences the stale controller)."""
+
+    epoch: int
+
+
+@dataclass(frozen=True)
+class CreateInstance:
+    blob_path: str
+    consensus_path: str
+    config: dict = field(default_factory=dict)  # dyncfg snapshot
+
+
+@dataclass(frozen=True)
+class CreateDataflow:
+    """Install a dataflow: a pickled DataflowDescription plus the persist
+    shard each source import reads from (data never rides this channel —
+    clusterd pulls from persist, exactly the reference architecture)."""
+
+    dataflow_id: str
+    desc: Any  # lir.DataflowDescription
+    source_shards: dict  # source gid -> shard id
+    as_of: int
+
+
+@dataclass(frozen=True)
+class AllowCompaction:
+    dataflow_id: str
+    since: int
+
+
+@dataclass(frozen=True)
+class Peek:
+    uuid: str
+    dataflow_id: str
+    index_id: str
+    at: Optional[int] = None
+
+
+@dataclass(frozen=True)
+class ProcessTo:
+    """Advance: pull new shard batches and step dataflows up to `upper`."""
+
+    upper: int
+
+
+@dataclass(frozen=True)
+class Ping:
+    pass
+
+
+# -- responses --------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Frontiers:
+    uppers: dict  # dataflow_id -> frontier
+
+
+@dataclass(frozen=True)
+class PeekResponse:
+    uuid: str
+    rows: Optional[list]
+    error: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class CommandErr:
+    message: str
+
+
+@dataclass(frozen=True)
+class Pong:
+    epoch: int

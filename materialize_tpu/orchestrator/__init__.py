@@ -1,0 +1,3 @@
+from .process import ProcessOrchestrator
+
+__all__ = ["ProcessOrchestrator"]

@@ -1,0 +1,112 @@
+"""Process orchestrator: run cluster replicas as local subprocesses.
+
+The analogue of the reference's `mz-orchestrator-process`
+(src/orchestrator-process): the dev/test stand-in for the kubernetes
+orchestrator, satisfying the same ensure_service shape
+(src/orchestrator/src/lib.rs:48-68) — named services with replica processes,
+ensure/drop semantics, and health checks.
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class Service:
+    name: str
+    processes: list = field(default_factory=list)  # subprocess.Popen
+    ports: list = field(default_factory=list)
+
+
+class ProcessOrchestrator:
+    def __init__(self, cpu: bool = True):
+        self.services: dict[str, Service] = {}
+        self.cpu = cpu
+
+    def ensure_service(self, name: str, scale: int = 1) -> list[tuple]:
+        """Start (or resize to) `scale` clusterd replicas; returns addresses."""
+        svc = self.services.get(name)
+        if svc is None:
+            svc = Service(name)
+            self.services[name] = svc
+        while len(svc.processes) < scale:
+            port = _free_port()
+            args = [
+                sys.executable,
+                "-m",
+                "materialize_tpu.cluster.clusterd",
+                "--port",
+                str(port),
+            ]
+            if self.cpu:
+                args.append("--cpu")
+            proc = subprocess.Popen(args)
+            svc.processes.append(proc)
+            svc.ports.append(port)
+        while len(svc.processes) > scale:
+            proc = svc.processes.pop()
+            svc.ports.pop()
+            proc.terminate()
+        self._await_ready(svc)
+        return [("127.0.0.1", port) for port in svc.ports]
+
+    def _await_ready(self, svc: Service, timeout: float = 30.0) -> None:
+        deadline = time.time() + timeout
+        for port in svc.ports:
+            while True:
+                try:
+                    with socket.create_connection(("127.0.0.1", port), timeout=1.0):
+                        break
+                except OSError:
+                    if time.time() > deadline:
+                        raise TimeoutError(f"replica on :{port} never came up")
+                    time.sleep(0.1)
+
+    def kill_replica(self, name: str, idx: int) -> None:
+        """Fault injection: kill one replica process (it stays in the service
+        at the same port slot — restart_replica brings it back)."""
+        svc = self.services[name]
+        svc.processes[idx].kill()
+        svc.processes[idx].wait()
+
+    def restart_replica(self, name: str, idx: int) -> None:
+        svc = self.services[name]
+        port = svc.ports[idx]
+        args = [
+            sys.executable,
+            "-m",
+            "materialize_tpu.cluster.clusterd",
+            "--port",
+            str(port),
+        ]
+        if self.cpu:
+            args.append("--cpu")
+        svc.processes[idx] = subprocess.Popen(args)
+        self._await_ready(svc)
+
+    def drop_service(self, name: str) -> None:
+        svc = self.services.pop(name, None)
+        if svc is None:
+            return
+        for proc in svc.processes:
+            proc.terminate()
+        for proc in svc.processes:
+            try:
+                proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    def shutdown(self) -> None:
+        for name in list(self.services):
+            self.drop_service(name)
