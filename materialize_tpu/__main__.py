@@ -18,6 +18,8 @@ import urllib.request
 
 
 def cmd_serve(args) -> None:
+    import signal
+
     from .adapter import Coordinator
     from .frontend import serve
 
@@ -35,11 +37,29 @@ def cmd_serve(args) -> None:
                     print(f"advance error: {e}", file=sys.stderr)
 
         threading.Thread(target=ticker, daemon=True).start()
-    try:
-        httpd.serve_forever()
-    except KeyboardInterrupt:
-        coord.checkpoint() if coord.durable else None
-        httpd.shutdown()
+
+    def graceful(_sig, _frame):
+        import os
+
+        # ignore further signals first: a second SIGTERM/SIGINT would re-enter
+        # this handler in the main thread and deadlock on the held lock
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
+        signal.signal(signal.SIGINT, signal.SIG_IGN)
+        # checkpoint (generator progress, catalog) before exit; explicit
+        # handlers because background-started processes inherit SIGINT=ignore
+        try:
+            with httpd.RequestHandlerClass.lock:
+                if coord.durable:
+                    coord.checkpoint()
+        except Exception as e:
+            print(f"shutdown checkpoint FAILED: {e}", file=sys.stderr, flush=True)
+            os._exit(1)
+        print("shut down (checkpointed)", flush=True)
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, graceful)
+    signal.signal(signal.SIGINT, graceful)
+    httpd.serve_forever()
 
 
 def cmd_sql(args) -> None:

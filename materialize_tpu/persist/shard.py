@@ -187,10 +187,12 @@ class ShardMachine:
         mergeable = [b for b in state.batches if b.count]
         if len(mergeable) <= 1:
             return
+        from ..utils.native import advance_times_host
+
         all_cols: dict[str, list] = {}
         for b in mergeable:
             cols = decode_columns(self.blob.get(b.key))
-            cols["times"] = np.maximum(cols["times"], np.uint64(state.since))
+            cols["times"] = advance_times_host(cols["times"], state.since)
             for k, v in cols.items():
                 all_cols.setdefault(k, []).append(v)
         merged = {k: np.concatenate(vs) for k, vs in all_cols.items()}
@@ -217,26 +219,8 @@ class ShardMachine:
 
 
 def _consolidate_host(cols: dict) -> dict:
-    """Host-side consolidation of columnar updates (NumPy oracle semantics)."""
-    data_keys = sorted(k for k in cols if k not in ("times", "diffs"))
-    arrays = [cols[k] for k in data_keys] + [cols["times"]]
-    order = np.lexsort(tuple(reversed(arrays)))
-    acc: dict = {}
-    times = cols["times"]
-    diffs = cols["diffs"]
-    for i in order:
-        key = tuple(cols[k][i].item() for k in data_keys) + (times[i].item(),)
-        acc[key] = acc.get(key, 0) + int(diffs[i])
-    rows = [(k, d) for k, d in acc.items() if d != 0]
-    n = len(rows)
-    out = {
-        k: np.empty(n, dtype=cols[k].dtype) for k in data_keys
-    }
-    out["times"] = np.empty(n, dtype=np.uint64)
-    out["diffs"] = np.empty(n, dtype=np.int64)
-    for i, (key, d) in enumerate(rows):
-        for j, k in enumerate(data_keys):
-            out[k][i] = key[j]
-        out["times"][i] = key[-1]
-        out["diffs"][i] = d
-    return out
+    """Host-side consolidation of columnar updates (native C++ kernel when
+    available — see native/consolidate.cpp — NumPy fallback otherwise)."""
+    from ..utils.native import consolidate_host
+
+    return consolidate_host(cols)

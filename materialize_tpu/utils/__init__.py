@@ -1,0 +1,3 @@
+from .native import advance_times_host, consolidate_host, get_native
+
+__all__ = ["advance_times_host", "consolidate_host", "get_native"]

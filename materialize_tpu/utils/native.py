@@ -1,0 +1,154 @@
+"""ctypes binding + on-demand build of the native host kernels.
+
+The C++ sources live in native/ and compile to a cached .so with g++ on first
+use (no pybind11 — plain C ABI, per the environment's toolchain constraints).
+Falls back to pure NumPy implementations when a compiler is unavailable.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "consolidate.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+_SO = os.path.join(_BUILD_DIR, "libmzt_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    try:
+        if os.path.exists(_SO) and (
+            not os.path.exists(_SRC)
+            or os.path.getmtime(_SO) >= os.path.getmtime(_SRC)
+        ):
+            return True
+        os.makedirs(_BUILD_DIR, exist_ok=True)
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+        )
+        return True
+    except (subprocess.CalledProcessError, OSError):
+        # no compiler / read-only tree / stripped sources: NumPy fallback
+        return False
+
+
+def get_native():
+    """The loaded native library, or None (NumPy fallback)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+        lib.mzt_consolidate.restype = ctypes.c_int64
+        lib.mzt_consolidate.argtypes = [
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int64)),
+            ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64,
+        ]
+        lib.mzt_advance_times.restype = None
+        lib.mzt_advance_times.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int64,
+            ctypes.c_uint64,
+        ]
+        _lib = lib
+        return _lib
+
+
+def consolidate_host(cols: dict) -> dict:
+    """Consolidate host columnar updates {'c0':…, 'times':…, 'diffs':…}.
+
+    Uses the native kernel when every data column is 64-bit; NumPy/Python
+    fallback otherwise.
+    """
+    data_keys = sorted(k for k in cols if k not in ("times", "diffs"))
+    n = int(len(cols["times"]))
+    if n == 0:
+        return cols
+    lib = get_native()
+    ok_64 = all(cols[k].dtype.itemsize == 8 and cols[k].dtype.kind in "iu" for k in data_keys)
+    if lib is not None and ok_64:
+        # exactly one copy in (native kernel mutates), viewed as u64 bit
+        # patterns so row order matches the NumPy fallback bit for bit
+        work = [
+            np.array(cols[k], dtype=np.int64, copy=True)
+            if cols[k].dtype.kind == "i"
+            else np.array(cols[k], dtype=np.uint64, copy=True).view(np.int64)
+            for k in data_keys
+        ]
+        times = np.array(cols["times"], dtype=np.uint64, copy=True)
+        diffs = np.array(cols["diffs"], dtype=np.int64, copy=True)
+        ptrs = (ctypes.POINTER(ctypes.c_int64) * len(work))(
+            *[w.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)) for w in work]
+        )
+        m = lib.mzt_consolidate(
+            ptrs,
+            len(work),
+            times.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            diffs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n,
+        )
+        out = {}
+        for k, w in zip(data_keys, work):
+            sliced = w[:m].copy()  # detach from the full-size buffer
+            out[k] = sliced if cols[k].dtype.kind == "i" else sliced.view(cols[k].dtype)
+        out["times"] = times[:m].copy()
+        out["diffs"] = diffs[:m].copy()
+        return out
+    return _consolidate_numpy(cols, data_keys)
+
+
+def _consolidate_numpy(cols: dict, data_keys) -> dict:
+    # canonical row order must match the native kernel bit for bit: data
+    # columns compare as signed i64 bit patterns, times as u64
+    def sort_view(a):
+        if a.dtype.itemsize == 8 and a.dtype.kind == "u":
+            return a.view(np.int64)
+        return a
+
+    arrays = [sort_view(cols[k]) for k in data_keys] + [cols["times"]]
+    order = np.lexsort(tuple(reversed(arrays)))
+    acc: dict = {}
+    times = cols["times"]
+    diffs = cols["diffs"]
+    for i in order:
+        key = tuple(cols[k][i].item() for k in data_keys) + (times[i].item(),)
+        acc[key] = acc.get(key, 0) + int(diffs[i])
+    rows = [(k, d) for k, d in acc.items() if d != 0]
+    n = len(rows)
+    out = {k: np.empty(n, dtype=cols[k].dtype) for k in data_keys}
+    out["times"] = np.empty(n, dtype=np.uint64)
+    out["diffs"] = np.empty(n, dtype=np.int64)
+    for i, (key, d) in enumerate(rows):
+        for j, k in enumerate(data_keys):
+            out[k][i] = key[j]
+        out["times"][i] = key[-1]
+        out["diffs"][i] = d
+    return out
+
+
+def advance_times_host(times: np.ndarray, since: int) -> np.ndarray:
+    lib = get_native()
+    if lib is not None and times.dtype == np.uint64:
+        t = np.ascontiguousarray(times).copy()
+        lib.mzt_advance_times(
+            t.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)), len(t), since
+        )
+        return t
+    return np.maximum(times, np.uint64(since))

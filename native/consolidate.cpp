@@ -1,0 +1,85 @@
+// Native host kernels for the persist/storage runtime.
+//
+// The reference's runtime is native end to end (Rust + C deps: jemalloc,
+// RocksDB, libdecnumber — SURVEY.md §2f); in this build the TPU data plane is
+// XLA and the *host* runtime hot loops are C++ behind a C ABI (ctypes
+// binding, no pybind11 dependency). This file: columnar consolidation —
+// sort updates by (data columns, time) and sum diffs of identical rows —
+// used by persist compaction and host-side batch maintenance
+// (differential's consolidate_updates, host edition).
+//
+// Layout: all columns are 64-bit words (i64/u64 bit patterns; the engine's
+// host payloads are fixed-width 64-bit columns). In-place: rows are permuted,
+// merged, and compacted to the front; returns the new live row count.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <numeric>
+#include <vector>
+
+extern "C" {
+
+// cols: ncols pointers to n-element i64 data columns
+// times: n u64 timestamps, diffs: n i64 multiplicities
+// returns: number of surviving rows (compacted to the front of every array)
+int64_t mzt_consolidate(int64_t** cols, int32_t ncols, uint64_t* times,
+                        int64_t* diffs, int64_t n) {
+  if (n <= 0) return 0;
+  std::vector<int64_t> idx(n);
+  std::iota(idx.begin(), idx.end(), 0);
+  std::sort(idx.begin(), idx.end(), [&](int64_t a, int64_t b) {
+    for (int32_t c = 0; c < ncols; ++c) {
+      if (cols[c][a] != cols[c][b]) return cols[c][a] < cols[c][b];
+    }
+    return times[a] < times[b];
+  });
+
+  auto same = [&](int64_t a, int64_t b) {
+    for (int32_t c = 0; c < ncols; ++c) {
+      if (cols[c][a] != cols[c][b]) return false;
+    }
+    return times[a] == times[b];
+  };
+
+  // merge runs into scratch, skipping rows whose diffs cancel
+  std::vector<std::vector<int64_t>> out_cols(ncols);
+  std::vector<uint64_t> out_times;
+  std::vector<int64_t> out_diffs;
+  for (int32_t c = 0; c < ncols; ++c) out_cols[c].reserve(n);
+  out_times.reserve(n);
+  out_diffs.reserve(n);
+
+  int64_t i = 0;
+  while (i < n) {
+    int64_t j = i;
+    int64_t total = 0;
+    while (j < n && same(idx[i], idx[j])) {
+      total += diffs[idx[j]];
+      ++j;
+    }
+    if (total != 0) {
+      for (int32_t c = 0; c < ncols; ++c) out_cols[c].push_back(cols[c][idx[i]]);
+      out_times.push_back(times[idx[i]]);
+      out_diffs.push_back(total);
+    }
+    i = j;
+  }
+
+  int64_t m = static_cast<int64_t>(out_times.size());
+  for (int32_t c = 0; c < ncols; ++c) {
+    std::memcpy(cols[c], out_cols[c].data(), m * sizeof(int64_t));
+  }
+  std::memcpy(times, out_times.data(), m * sizeof(uint64_t));
+  std::memcpy(diffs, out_diffs.data(), m * sizeof(int64_t));
+  return m;
+}
+
+// advance all times to at least `since` (logical compaction), in place
+void mzt_advance_times(uint64_t* times, int64_t n, uint64_t since) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (times[i] < since) times[i] = since;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,26 @@
+"""Native and NumPy consolidation must agree on canonical row ORDER too
+(serialized shard bytes must not depend on toolchain availability)."""
+
+import numpy as np
+
+from materialize_tpu.utils.native import _consolidate_numpy, consolidate_host, get_native
+
+
+def test_order_identical_incl_high_bit_u64(rng):
+    if get_native() is None:
+        import pytest
+
+        pytest.skip("no compiler")
+    n = 500
+    cols = {
+        # u64 hashes with the high bit set on half the rows
+        "c0": (rng.integers(0, 1 << 62, n).astype(np.uint64) * 3),
+        "c1": rng.integers(-50, 50, n).astype(np.int64),
+        "times": rng.integers(0, 3, n).astype(np.uint64),
+        "diffs": rng.integers(-1, 2, n).astype(np.int64),
+    }
+    got = consolidate_host({k: v.copy() for k, v in cols.items()})
+    want = _consolidate_numpy({k: v.copy() for k, v in cols.items()}, ["c0", "c1"])
+    for k in ("c0", "c1", "times", "diffs"):
+        np.testing.assert_array_equal(got[k], want[k]), k
+        assert got[k].dtype == want[k].dtype
