@@ -26,7 +26,7 @@ from ..sql import ast
 from ..sql.lower import Lowerer, lower_to_dataflow
 from ..sql.parser import parse_statement, parse_statements
 from ..sql.plan import PlanError, Planner, PlannedQuery, PType
-from ..storage.generator import AuctionGenerator, TpchGenerator
+from ..storage.generator import AuctionGenerator, CounterGenerator, TpchGenerator
 from ..transform import optimize
 from .catalog import Catalog, CatalogItem, coltype_of
 from .timestamp_oracle import TimestampOracle
@@ -79,6 +79,9 @@ class Coordinator:
         # installed continuous dataflows in dependency order: (mv_gid, Dataflow, src_gids)
         self.dataflows: list = []
         self.planner = Planner(self.catalog)
+        from .dyncfg import default_configs
+
+        self.configs = default_configs()
         self.blob = blob
         self.consensus = consensus
         if data_dir is not None:
@@ -136,6 +139,14 @@ class Coordinator:
             return self._drop(stmt)
         if isinstance(stmt, ast.Subscribe):
             return self._subscribe(stmt)
+        if isinstance(stmt, ast.SetVariable):
+            try:
+                self.configs.set(stmt.name, stmt.value)
+            except KeyError as e:
+                raise PlanError(str(e))
+            return ExecResult("status", status="SET")
+        if isinstance(stmt, ast.Update):
+            return self._update(stmt)
         raise PlanError(f"unsupported statement: {type(stmt).__name__}")
 
     # -- subscriptions ---------------------------------------------------------
@@ -244,6 +255,10 @@ class Coordinator:
         if stmt.generator == "auction":
             gen = AuctionGenerator(seed=0, dict_=self.catalog.dict)
             tables = self._AUCTION_TABLES
+        elif stmt.generator == "counter":
+            maxc = opts.get("max cardinality")
+            gen = CounterGenerator(int(maxc) if maxc else None)
+            tables = {"counter": RelationDesc.of(("counter", ColType.INT64))}
         elif stmt.generator == "tpch":
             sf = float(opts.get("scale factor", 0.01) or 0.01)
             gen = TpchGenerator(sf=sf)
@@ -285,7 +300,7 @@ class Coordinator:
             from ..sql.plan import _apply_finishing_as_topk
 
             rel = _apply_finishing_as_topk(pq)
-        rel = optimize(rel)
+        rel = optimize(rel, self.configs)
         item = self.catalog.create(
             CatalogItem(stmt.name, "materialized_view", desc=pq.desc, query_ast=stmt.query)
         )
@@ -380,6 +395,71 @@ class Coordinator:
         batch = UpdateBatch.build((), cols, np.full(n, ts), -np.ones(n, dtype=np.int64))
         self._apply_writes({item.global_id: batch}, ts)
         return ExecResult("status", status=f"DELETE {n}")
+
+    def _update(self, stmt: ast.Update) -> ExecResult:
+        """UPDATE = retract matching rows + insert modified versions (the
+        read-then-write shape of the reference's sequence_update)."""
+        item = self.catalog.get(stmt.table)
+        if item.kind != "table":
+            raise PlanError(f"cannot UPDATE {item.kind}")
+        q = ast.Query(
+            ast.Select(
+                items=(ast.SelectItem(ast.Star()),),
+                from_=(ast.TableRef(stmt.table),),
+                where=stmt.where,
+            )
+        )
+        res = self._select(q)
+        if not res.rows:
+            return ExecResult("status", status="UPDATE 0")
+        desc = item.desc
+        assign = {col: e for col, e in stmt.assignments}
+        enc = self.catalog.dict.encode
+
+        def encode_val(v, cd):
+            if isinstance(v, str):
+                return enc(v)
+            if cd.typ == ColType.NUMERIC and isinstance(v, float):
+                return int(round(v * 10**cd.scale))
+            return v
+
+        old_cols = [[] for _ in range(desc.arity)]
+        new_cols = [[] for _ in range(desc.arity)]
+        from ..sql.plan import Scope, ScopeCol, PType
+
+        scope = Scope(
+            [
+                ScopeCol(stmt.table, c.name, PType(c.typ, c.scale if c.typ == ColType.NUMERIC else 0))
+                for c in desc.columns
+            ]
+        )
+        for row in res.rows:
+            encoded = [encode_val(v, desc.columns[i]) for i, v in enumerate(row)]
+            for i in range(desc.arity):
+                old_cols[i].append(encoded[i])
+            newrow = list(encoded)
+            for i, c in enumerate(desc.columns):
+                if c.name in assign:
+                    # evaluate assignment expression against the OLD row
+                    e, _t = self.planner.plan_scalar(assign[c.name], scope)
+                    newrow[i] = _eval_scalar_on_row(e, encoded)
+            for i in range(desc.arity):
+                new_cols[i].append(newrow[i])
+        import numpy as _np
+
+        ts = self.oracle.write_ts()
+        n = len(res.rows)
+        arrays = tuple(
+            _np.concatenate([
+                _np.array(old_cols[i], dtype=desc.columns[i].dtype),
+                _np.array(new_cols[i], dtype=desc.columns[i].dtype),
+            ])
+            for i in range(desc.arity)
+        )
+        diffs = _np.concatenate([-_np.ones(n, dtype=_np.int64), _np.ones(n, dtype=_np.int64)])
+        batch = UpdateBatch.build((), arrays, _np.full(2 * n, ts), diffs)
+        self._apply_writes({item.global_id: batch}, ts)
+        return ExecResult("status", status=f"UPDATE {n}")
 
     def _literal_value(self, e, cdesc: ColumnDesc):
         if isinstance(e, ast.NumberLit):
@@ -589,6 +669,8 @@ class Coordinator:
         for gen, gids in self.generators:
             if isinstance(gen, AuctionGenerator):
                 batches = gen.next_tick(ts, n_rows)
+            elif isinstance(gen, CounterGenerator):
+                batches = gen.next_tick(ts, 1)
             else:
                 batches = gen.refresh(ts)
             for t, b in batches.items():
@@ -601,7 +683,7 @@ class Coordinator:
     # -- reads -----------------------------------------------------------------
     def _select(self, query: ast.Query) -> ExecResult:
         pq = self.planner.plan_query(query)
-        rel = optimize(pq.mir)
+        rel = optimize(pq.mir, self.configs)
         as_of = self.oracle.read_ts()
 
         rows = self._peek_fast_path(rel, as_of)
@@ -679,6 +761,10 @@ class Coordinator:
             "materialized": ("materialized_view",),
         }
         kinds = kind_map.get(stmt.what)
+        if kinds is None and stmt.what in self.configs.names():
+            return ExecResult(
+                "rows", rows=[(str(self.configs.get(stmt.what)),)], columns=(stmt.what,)
+            )
         if kinds is None:
             if stmt.what == "columns" and stmt.on:
                 item = self.catalog.get(stmt.on)
@@ -689,17 +775,67 @@ class Coordinator:
         return ExecResult("rows", rows=sorted(rows), columns=("name",))
 
 
+def _eval_scalar_on_row(e, row: list):
+    """Host interpreter for a planned ScalarExpr over one encoded row
+    (UPDATE assignment evaluation; mirrors eval_expr's semantics)."""
+    from ..expr import scalar as s
+
+    if isinstance(e, s.Column):
+        return row[e.index]
+    if isinstance(e, s.Literal):
+        return e.value
+    if isinstance(e, s.CallUnary):
+        v = _eval_scalar_on_row(e.expr, row)
+        return {
+            "neg": lambda: -v,
+            "not": lambda: not v,
+            "abs": lambda: abs(v),
+            "cast_int64": lambda: int(v),
+            "cast_int32": lambda: int(v),
+            "cast_float": lambda: float(v),
+            "is_true": lambda: bool(v),
+        }[e.func]()
+    if isinstance(e, s.CallBinary):
+        l = _eval_scalar_on_row(e.left, row)
+        r = _eval_scalar_on_row(e.right, row)
+        if e.func in ("div", "floordiv"):
+            if r == 0:
+                raise PlanError("division by zero")
+            q = abs(l) // abs(r)
+            return -q if (l < 0) != (r < 0) else q
+        return {
+            "add": lambda: l + r,
+            "sub": lambda: l - r,
+            "mul": lambda: l * r,
+            "mod": lambda: l - r * (abs(l) // abs(r)) * (1 if (l < 0) == (r < 0) else -1),
+            "eq": lambda: l == r,
+            "ne": lambda: l != r,
+            "lt": lambda: l < r,
+            "lte": lambda: l <= r,
+            "gt": lambda: l > r,
+            "gte": lambda: l >= r,
+            "and": lambda: l and r,
+            "or": lambda: l or r,
+            "min": lambda: min(l, r),
+            "max": lambda: max(l, r),
+        }[e.func]()
+    if isinstance(e, s.CallVariadic):
+        vs = [_eval_scalar_on_row(x, row) for x in e.exprs]
+        if e.func == "if":
+            return vs[1] if vs[0] else vs[2]
+        if e.func == "and":
+            return all(vs)
+        if e.func == "or":
+            return any(vs)
+        if e.func == "greatest":
+            return max(vs)
+        if e.func == "least":
+            return min(vs)
+    raise PlanError(f"cannot evaluate {e!r} host-side")
+
+
 def _collect_gets(e) -> set:
-    out = set()
-
-    def go(n):
-        if isinstance(n, mir.MirGet):
-            out.add(n.id)
-        for k in mir.children(n):
-            go(k)
-
-    go(e)
-    return out
+    return mir.collect_get_ids(e)
 
 
 def explain_mir(e, indent: int = 0) -> str:

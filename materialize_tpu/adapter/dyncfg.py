@@ -1,0 +1,96 @@
+"""dyncfg — dynamically updatable typed configuration.
+
+The analogue of the reference's `mz-dyncfg` (src/dyncfg/src/lib.rs:9-30):
+typed `Config` constants registered into a `ConfigSet`, updatable at runtime
+(`ALTER SYSTEM SET …`), consulted by the optimizer and renderer, and shipped
+to cluster replicas in CreateInstance / UpdateConfiguration (the
+ComputeCommand::UpdateConfiguration path, protocol/command.rs:93).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class Config:
+    name: str
+    default: Any
+    description: str = ""
+
+    @property
+    def typ(self) -> type:
+        return type(self.default)
+
+
+class ConfigSet:
+    def __init__(self, configs: list[Config]):
+        self._configs = {c.name: c for c in configs}
+        self._values: dict[str, Any] = {}
+
+    def get(self, name: str):
+        c = self._configs.get(name)
+        if c is None:
+            raise KeyError(f"unknown configuration parameter: {name}")
+        return self._values.get(name, c.default)
+
+    def set(self, name: str, value) -> None:
+        c = self._configs.get(name)
+        if c is None:
+            raise KeyError(f"unknown configuration parameter: {name}")
+        if c.typ is bool:
+            if isinstance(value, str):
+                value = value.lower() in ("true", "on", "1", "yes")
+            value = bool(value)
+        elif c.typ is int:
+            value = int(value)
+        elif c.typ is float:
+            value = float(value)
+        else:
+            value = str(value)
+        self._values[name] = value
+
+    def reset(self, name: str) -> None:
+        self._values.pop(name, None)
+
+    def snapshot(self) -> dict:
+        return {name: self.get(name) for name in self._configs}
+
+    def names(self) -> list[str]:
+        return sorted(self._configs)
+
+
+# engine configs (the compute-dyncfgs analogue, src/compute-types/src/dyncfgs.rs)
+ENABLE_DELTA_JOIN = Config(
+    "enable_delta_join",
+    True,
+    "plan 3+-way joins as delta joins (one update path per input); "
+    "off = linear binary chains (the ENABLE_MZ_JOIN_CORE-style rendering flag)",
+)
+DELTA_JOIN_MAX_INPUTS = Config(
+    "delta_join_max_inputs",
+    6,
+    "joins wider than this always chain linearly",
+)
+LSM_MERGE_RATIO = Config(
+    "lsm_merge_ratio", 8, "geometric ratio of arrangement LSM level merges"
+)
+INDEX_FAST_PATH = Config(
+    "enable_index_fast_path", True, "serve bare-Get peeks from maintained indexes"
+)
+INTROSPECTION = Config(
+    "enable_introspection", True, "expose mz_* introspection relations"
+)
+
+ALL_CONFIGS = [
+    ENABLE_DELTA_JOIN,
+    DELTA_JOIN_MAX_INPUTS,
+    LSM_MERGE_RATIO,
+    INDEX_FAST_PATH,
+    INTROSPECTION,
+]
+
+
+def default_configs() -> ConfigSet:
+    return ConfigSet(ALL_CONFIGS)

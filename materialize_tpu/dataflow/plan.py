@@ -152,6 +152,21 @@ class ArrangeBy:
     key_cols: tuple[int, ...]
 
 
+@dataclass(frozen=True)
+class LetRec:
+    """Iterative scope: bindings reference each other via Get(rec_id) and are
+    iterated to fixpoint within each outer tick (reference: render.rs:887
+    render_recursive_plan over PointStamp scopes; here the inner dataflow's
+    private timestamp IS the iteration counter)."""
+
+    bindings: tuple  # ((rec_id, plan, dtypes), ...)
+    body: Any
+    body_dtypes: tuple
+    external_ids: tuple  # outer collections the scope reads
+    ext_dtypes: tuple  # ((id, dtypes), ...) aligned with external_ids
+    max_iters: int = 100
+
+
 # ---------------------------------------------------------------------------
 # dataflow description
 # ---------------------------------------------------------------------------

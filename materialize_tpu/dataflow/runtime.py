@@ -344,6 +344,99 @@ class TopKNode(Node):
         self.arr.compact(since)
 
 
+class LetRecNode(Node):
+    """Iterate bindings to fixpoint within each outer tick.
+
+    An inner incremental Dataflow hosts the bindings and body; its private
+    timestamp is the iteration counter, so each iteration's work is
+    proportional to the CHANGE since the previous iterate — exactly
+    differential's iterate/Variable semantics on the inner coordinate of a
+    product timestamp (reference: render.rs:365,887). The outer output delta
+    is the telescoped sum of per-iteration body deltas, retimed to the tick.
+    """
+
+    def __init__(self, expr):
+        self.expr = expr
+        self.rec_ids = [b[0] for b in expr.bindings]
+        self.external_ids = list(expr.external_ids)
+        self.max_iters = expr.max_iters
+        src = {gid: dts for gid, dts in expr.ext_dtypes}
+        for gid, _plan, dts in expr.bindings:
+            src[gid] = dts
+        builds = [lir.BuildDesc(gid, plan, dts) for gid, plan, dts in expr.bindings]
+        builds.append(lir.BuildDesc("__letrec_body__", expr.body, expr.body_dtypes))
+        desc = lir.DataflowDescription(
+            source_imports=src,
+            objects_to_build=builds,
+            index_exports={},
+        )
+        self.inner = Dataflow(desc)
+        self.inner_time = 0
+        self.started = False
+
+    def step(self, tick, ins):
+        ext: dict = {}
+        errs_parts = []
+        for eid, d in zip(self.external_ids, ins):
+            if d is None:
+                continue
+            if d[0] is not None:
+                ext[eid] = d[0]
+            if d[1] is not None:
+                errs_parts.append(d[1])
+        if not ext and self.started:
+            return None if not errs_parts else (None, _union(errs_parts))
+        self.started = True
+
+        acc_out = []
+        deltas = dict(ext)
+        for _it in range(self.max_iters):
+            self.inner_time += 1
+            results = self.inner.step(self.inner_time, deltas)
+            deltas = {}
+            converged = True
+            for rec_id in self.rec_ids:
+                d = results.get(rec_id)
+                if d is None:
+                    continue
+                if d[1] is not None and int(d[1].count()) > 0:
+                    errs_parts.append(_retime(d[1], tick))
+                if d[0] is not None and int(d[0].count()) > 0:
+                    deltas[rec_id] = d[0]
+                    converged = False
+            body = results.get("__letrec_body__")
+            if body is not None:
+                if body[0] is not None:
+                    acc_out.append(body[0])
+                if body[1] is not None and int(body[1].count()) > 0:
+                    errs_parts.append(_retime(body[1], tick))
+            if converged:
+                break
+        else:
+            raise RuntimeError(
+                f"WITH MUTUALLY RECURSIVE did not converge in {self.max_iters} iterations"
+            )
+        out = _union([_retime(b, tick) for b in acc_out]) if acc_out else None
+        errs = _union(errs_parts) if errs_parts else None
+        if out is None and errs is None:
+            return None
+        return out, errs
+
+
+def _retime(batch: UpdateBatch, tick: int) -> UpdateBatch:
+    """Overwrite live rows' times with the outer tick (iteration timestamps
+    are scope-private, like the inner coordinate of a product timestamp)."""
+    t = jnp.asarray(tick, dtype=jnp.uint64)
+    live = batch.live
+    return UpdateBatch(
+        batch.hashes,
+        batch.keys,
+        batch.vals,
+        jnp.where(live, t, batch.times),
+        batch.diffs,
+    )
+
+
 # ---------------------------------------------------------------------------
 # dataflow
 # ---------------------------------------------------------------------------
@@ -460,6 +553,9 @@ class Dataflow:
             ref = self._render(e.input, ops)
             ops.append((TopKNode(e.plan), [ref]))
             return len(ops) - 1
+        if isinstance(e, lir.LetRec):
+            ops.append((LetRecNode(e), list(e.external_ids)))
+            return len(ops) - 1
         raise NotImplementedError(f"render: {type(e).__name__}")
 
     def _infer_dtypes(self, expr) -> tuple:
@@ -500,6 +596,8 @@ class Dataflow:
                     base.append(_expr_dtype(m, base))
                 cols = [base[i] for i in e.closure.projection]
             return tuple(cols)
+        if isinstance(e, lir.LetRec):
+            return tuple(e.body_dtypes)
         raise NotImplementedError(f"dtypes: {type(e).__name__}")
 
     # -- execution ---------------------------------------------------------

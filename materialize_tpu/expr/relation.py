@@ -108,6 +108,17 @@ class MirDistinct:
     input: Any
 
 
+@dataclass(frozen=True)
+class MirLetRec:
+    """WITH MUTUALLY RECURSIVE: bindings may reference each other (and
+    themselves) via MirGet of their rec ids; evaluated to fixpoint per
+    timestamp (reference: relation.rs LetRec + iterative PointStamp scopes,
+    src/compute/src/render.rs:365)."""
+
+    bindings: tuple  # ((rec_id, dtypes, MirExpr), ...)
+    body: Any
+
+
 MirExpr = Any
 
 
@@ -133,6 +144,8 @@ def arity(e: MirExpr) -> int:
         return arity(e.input) if not isinstance(e, MirDistinct) else arity(e.input)
     if isinstance(e, MirUnion):
         return arity(e.inputs[0])
+    if isinstance(e, MirLetRec):
+        return arity(e.body)
     raise TypeError(f"not a MirExpr: {e!r}")
 
 
@@ -143,7 +156,26 @@ def children(e: MirExpr) -> tuple:
         return (e.input,)
     if isinstance(e, (MirJoin, MirUnion)):
         return tuple(e.inputs)
+    if isinstance(e, MirLetRec):
+        return tuple(b[2] for b in e.bindings) + (e.body,)
     raise TypeError(f"not a MirExpr: {e!r}")
+
+
+def collect_get_ids(e: MirExpr) -> set:
+    """FREE MirGet ids of a tree (LetRec binding ids are bound, not free)."""
+    if isinstance(e, MirGet):
+        return {e.id}
+    if isinstance(e, MirLetRec):
+        bound = {b[0] for b in e.bindings}
+        out: set = set()
+        for _g, _d, b in e.bindings:
+            out |= collect_get_ids(b)
+        out |= collect_get_ids(e.body)
+        return out - bound
+    out = set()
+    for k in children(e):
+        out |= collect_get_ids(k)
+    return out
 
 
 def with_children(e: MirExpr, new: tuple) -> MirExpr:
@@ -153,4 +185,9 @@ def with_children(e: MirExpr, new: tuple) -> MirExpr:
         return replace(e, input=new[0])
     if isinstance(e, (MirJoin, MirUnion)):
         return replace(e, inputs=tuple(new))
+    if isinstance(e, MirLetRec):
+        nb = tuple(
+            (b[0], b[1], body) for b, body in zip(e.bindings, new[:-1])
+        )
+        return MirLetRec(nb, new[-1])
     raise TypeError(f"not a MirExpr: {e!r}")

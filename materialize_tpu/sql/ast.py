@@ -160,13 +160,27 @@ class Select:
 
 
 @dataclass(frozen=True)
+class CteBinding:
+    """WITH binding; `columns` (name, type) pairs are required for MUTUALLY
+    RECURSIVE bindings (as in the reference's WMR syntax) and absent for
+    plain CTEs."""
+
+    name: str
+    query: Any
+    columns: tuple = ()
+
+
+@dataclass(frozen=True)
 class Query:
-    """Select plus set-ops / ordering / limit."""
+    """Select plus set-ops / ordering / limit, optionally under WITH [MUTUALLY
+    RECURSIVE] bindings."""
 
     body: Any  # Select | SetOp
     order_by: tuple = ()
     limit: Optional[int] = None
     offset: int = 0
+    ctes: tuple = ()  # of CteBinding
+    recursive: bool = False
 
 
 @dataclass(frozen=True)
@@ -260,6 +274,18 @@ class DropObject:
     kind: str  # table | view | source | index | materialized view
     name: str
     if_exists: bool = False
+
+
+@dataclass(frozen=True)
+class SetVariable:
+    name: str
+    value: str
+    system: bool = False  # ALTER SYSTEM SET vs session SET
+
+
+@dataclass(frozen=True)
+class ShowVariable:
+    name: str
 
 
 @dataclass(frozen=True)

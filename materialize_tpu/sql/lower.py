@@ -67,6 +67,10 @@ class Lowerer:
             return self.dtypes(e.input)
         if isinstance(e, mir.MirUnion):
             return self.dtypes(e.inputs[0])
+        if isinstance(e, mir.MirLetRec):
+            for gid, dts, _b in e.bindings:
+                self.env[gid] = tuple(dts)
+            return self.dtypes(e.body)
         raise TypeError(f"dtypes: {type(e).__name__}")
 
     # -- lowering -------------------------------------------------------------
@@ -135,6 +139,27 @@ class Lowerer:
             )
         if isinstance(e, mir.MirUnion):
             return lir.Union(tuple(self.lower(i) for i in e.inputs))
+        if isinstance(e, mir.MirLetRec):
+            rec_ids = set()
+            for gid, dts, _b in e.bindings:
+                self.env[gid] = tuple(dts)
+                rec_ids.add(gid)
+            bindings = tuple(
+                (gid, self.lower(b), tuple(dts)) for gid, dts, b in e.bindings
+            )
+            body = self.lower(e.body)
+            refs = set()
+            for _g, _d, b in e.bindings:
+                refs |= mir.collect_get_ids(b)
+            refs |= mir.collect_get_ids(e.body)
+            ext = tuple(sorted(refs - rec_ids))
+            return lir.LetRec(
+                bindings=bindings,
+                body=body,
+                body_dtypes=self.dtypes(e.body),
+                external_ids=ext,
+                ext_dtypes=tuple((g, tuple(self.env[g])) for g in ext),
+            )
         raise TypeError(f"lower: {type(e).__name__}")
 
     def lower_reduce(self, e: mir.MirReduce):

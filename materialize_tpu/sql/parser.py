@@ -69,7 +69,7 @@ class Parser:
 
     # -- entry ----------------------------------------------------------------
     def parse_statement(self):
-        if self.at_kw("select") or self.at_op("("):
+        if self.at_kw("select", "with") or self.at_op("("):
             return ast.SelectStatement(self.parse_query())
         if self.at_kw("create"):
             return self.parse_create()
@@ -85,6 +85,23 @@ class Parser:
             return self.parse_show()
         if self.at_kw("drop"):
             return self.parse_drop()
+        if self.at_kw("alter"):
+            self.next()
+            self.expect_kw("system")
+            self.expect_kw("set")
+            name = self.ident()
+            self.expect_op("=")
+            t = self.next()
+            return ast.SetVariable(name, t.value, system=True)
+        if self.at_kw("set"):
+            self.next()
+            name = self.ident()
+            if self.eat_kw("to"):
+                pass
+            else:
+                self.expect_op("=")
+            t = self.next()
+            return ast.SetVariable(name, t.value, system=False)
         if self.at_kw("subscribe"):
             self.next()
             self.eat_kw("to")
@@ -271,6 +288,37 @@ class Parser:
 
     # -- queries ----------------------------------------------------------------
     def parse_query(self) -> ast.Query:
+        ctes: list = []
+        recursive = False
+        if self.at_kw("with") and not self.at_kw("when"):
+            self.next()
+            if self.peek().value == "mutually":
+                self.next()
+                if self.peek().value != "recursive":
+                    raise ParseError("expected RECURSIVE after MUTUALLY")
+                self.next()
+                recursive = True
+            elif self.peek().value == "recursive":
+                self.next()
+                recursive = True
+            while True:
+                name = self.ident()
+                cols = []
+                if self.at_op("("):
+                    self.next()
+                    while not self.at_op(")"):
+                        cname = self.ident()
+                        ctyp = self.parse_type_name()
+                        cols.append((cname, ctyp))
+                        self.eat_op(",")
+                    self.expect_op(")")
+                self.expect_kw("as")
+                self.expect_op("(")
+                q = self.parse_query()
+                self.expect_op(")")
+                ctes.append(ast.CteBinding(name, q, tuple(cols)))
+                if not self.eat_op(","):
+                    break
         body = self.parse_set_expr()
         order_by = []
         if self.eat_kw("order"):
@@ -293,7 +341,9 @@ class Parser:
             limit = int(self.next().value)
         if self.eat_kw("offset"):
             offset = int(self.next().value)
-        return ast.Query(body, tuple(order_by), limit, offset)
+        return ast.Query(
+            body, tuple(order_by), limit, offset, tuple(ctes), recursive
+        )
 
     def parse_set_expr(self):
         left = self.parse_select_core()

@@ -125,6 +125,14 @@ def _rescale(e, from_scale: int, to_scale: int):
 class Planner:
     def __init__(self, catalog):
         self.catalog = catalog
+        self._cte_frames: list[dict] = []  # name -> ("cte", PlannedQuery) | ("rec", gid, Scope)
+        self._rec_counter = 0
+
+    def _lookup_cte(self, name: str):
+        for frame in reversed(self._cte_frames):
+            if name in frame:
+                return frame[name]
+        return None
 
     # -- expression planning -------------------------------------------------
     def plan_scalar(self, e, scope: Scope):
@@ -305,7 +313,52 @@ class Planner:
 
     # -- relation planning ---------------------------------------------------
     def plan_query(self, q: ast.Query) -> PlannedQuery:
-        rel, scope = self.plan_set_expr(q.body)
+        frame: dict = {}
+        rec_bindings: list = []
+        if q.ctes:
+            self._cte_frames.append(frame)
+            if q.recursive:
+                # declare every binding up front (bodies may reference any)
+                from ..adapter.catalog import coltype_of
+
+                for b in q.ctes:
+                    if not b.columns:
+                        raise PlanError(
+                            f"WITH MUTUALLY RECURSIVE binding {b.name} needs "
+                            "explicit column types (name type, …)"
+                        )
+                    gid = f"rec{self._rec_counter}_{b.name}"
+                    self._rec_counter += 1
+                    cols = [
+                        ScopeCol(b.name, cname, PType(coltype_of(ctyp),
+                                 2 if coltype_of(ctyp) == ColType.NUMERIC else 0))
+                        for cname, ctyp in b.columns
+                    ]
+                    frame[b.name] = ("rec", gid, Scope(cols))
+                for b in q.ctes:
+                    pq = self.plan_query(b.query)
+                    if len(pq.scope.cols) != len(b.columns):
+                        raise PlanError(
+                            f"binding {b.name}: body arity {len(pq.scope.cols)} "
+                            f"!= declared {len(b.columns)}"
+                        )
+                    _k, gid, scope = frame[b.name]
+                    brel = pq.mir
+                    if pq.finishing.limit is not None:
+                        brel = _apply_finishing_as_topk(pq)
+                    rec_bindings.append(
+                        (gid, tuple(c.typ.dtype for c in scope.cols), brel)
+                    )
+            else:
+                for b in q.ctes:
+                    frame[b.name] = ("cte", self.plan_query(b.query))
+        try:
+            rel, scope = self.plan_set_expr(q.body)
+        finally:
+            if q.ctes:
+                self._cte_frames.pop()
+        if rec_bindings:
+            rel = mir.MirLetRec(tuple(rec_bindings), rel)
         order, limit, offset = q.order_by, q.limit, q.offset
         order_idx = []
         for ob in order:
@@ -455,6 +508,25 @@ class Planner:
 
     def _flatten_from(self, f, factors, scopes, on_preds):
         if isinstance(f, ast.TableRef):
+            cte = self._lookup_cte(f.name)
+            if cte is not None:
+                alias = f.alias or f.name
+                if cte[0] == "rec":
+                    _k, gid, rscope = cte
+                    factors.append(mir.MirGet(gid, len(rscope.cols)))
+                    scopes.append(
+                        Scope([ScopeCol(alias, c.name, c.typ) for c in rscope.cols])
+                    )
+                    return
+                pq = cte[1]
+                rel = pq.mir
+                if pq.finishing.limit is not None:
+                    rel = _apply_finishing_as_topk(pq)
+                factors.append(rel)
+                scopes.append(
+                    Scope([ScopeCol(alias, c.name, c.typ) for c in pq.scope.cols])
+                )
+                return
             item = self.catalog.get(f.name)
             if item.desc is None:
                 raise PlanError(f"{f.name} has no relation description")

@@ -93,6 +93,29 @@ class AuctionGenerator:
         }
 
 
+class CounterGenerator:
+    """COUNTER load generator (load_generator.rs:150-155): emits 1, 2, 3, …;
+    with max_cardinality, value v-max is retracted when v is emitted."""
+
+    def __init__(self, max_cardinality: int | None = None):
+        self.max_cardinality = max_cardinality
+        self.next = 1
+
+    def next_tick(self, tick: int, n_rows: int = 1) -> dict[str, UpdateBatch]:
+        vals = np.arange(self.next, self.next + n_rows, dtype=np.int64)
+        self.next += n_rows
+        diffs = np.ones(n_rows, dtype=np.int64)
+        if self.max_cardinality is not None:
+            dead = vals - self.max_cardinality
+            keep = dead >= 1
+            vals = np.concatenate([vals, dead[keep]])
+            diffs = np.concatenate([diffs, -np.ones(int(keep.sum()), dtype=np.int64)])
+        n = len(vals)
+        return {
+            "counter": UpdateBatch.build((), (vals,), np.full(n, tick), diffs)
+        }
+
+
 def date_num(y: int, m: int, d: int) -> int:
     """Days since 1992-01-01 (TPC-H epoch)."""
     return (np.datetime64(f"{y:04d}-{m:02d}-{d:02d}") - np.datetime64("1992-01-01")).astype(int)

@@ -40,7 +40,9 @@ def _offsets(arities):
     return out
 
 
-def plan_join_implementation(join: mir.MirJoin) -> JoinPlanned:
+def plan_join_implementation(
+    join: mir.MirJoin, enable_delta: bool = True, max_delta_inputs: int = 6
+) -> JoinPlanned:
     arities = [mir.arity(i) for i in join.inputs]
     offsets = _offsets(arities)
     n = len(join.inputs)
@@ -112,10 +114,11 @@ def plan_join_implementation(join: mir.MirJoin) -> JoinPlanned:
         plan = lir.LinearJoinPlan(stages=(lir.JoinStage(skey, lkey),))
         return JoinPlanned("linear", plan, (0, 1), tuple(residuals))
 
-    if n > 6:
-        # very wide joins: chain linearly in input order (delta paths grow
-        # O(n^2) lookups; reference caps delta breadth similarly and has
-        # tested 64-relation linear chains, README.md:46)
+    if n > max_delta_inputs or not enable_delta:
+        # very wide joins (or delta joins disabled by dyncfg): chain linearly
+        # in input order (delta paths grow O(n^2) lookups; reference caps
+        # delta breadth similarly and has tested 64-relation linear chains,
+        # README.md:46)
         stages = []
         done = {0}
         stream_cols = [(0, j) for j in range(arities[0])]

@@ -132,21 +132,34 @@ def fold_constants(e):
     return _map_tree(e, go)
 
 
-def attach_join_plans(e):
+def attach_join_plans(e, configs=None):
+    enable_delta = True
+    max_inputs = 6
+    if configs is not None:
+        enable_delta = bool(configs.get("enable_delta_join"))
+        max_inputs = int(configs.get("delta_join_max_inputs"))
+
     def go(n):
         if isinstance(n, mir.MirJoin) and n.implementation is None:
-            return replace(n, implementation=plan_join_implementation(n))
+            return replace(
+                n,
+                implementation=plan_join_implementation(
+                    n, enable_delta=enable_delta, max_delta_inputs=max_inputs
+                ),
+            )
         return n
 
     return _map_tree(e, go)
 
 
-def optimize(e):
+def optimize(e, configs=None):
     """The logical+physical pipeline (reference: logical_optimizer lib.rs:752
-    then physical_optimizer lib.rs:822, much abbreviated)."""
+    then physical_optimizer lib.rs:822, much abbreviated). `configs` is the
+    dyncfg ConfigSet gating optimizer choices (lib.rs:580 conditional
+    transforms)."""
     e = fuse(e)
     e = predicate_pushdown(e)
     e = fuse(e)
     e = fold_constants(e)
-    e = attach_join_plans(e)
+    e = attach_join_plans(e, configs)
     return e
