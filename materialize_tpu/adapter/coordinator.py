@@ -229,7 +229,7 @@ class Coordinator:
 
     _TPCH_TABLES = {
         "customer": RelationDesc.of(
-            ("c_custkey", ColType.INT64), ("c_mktsegment", ColType.INT64),
+            ("c_custkey", ColType.INT64), ("c_mktsegment", ColType.STRING),
             ("c_nationkey", ColType.INT64), key=(0,),
         ),
         "orders": RelationDesc.of(
@@ -261,7 +261,10 @@ class Coordinator:
             tables = {"counter": RelationDesc.of(("counter", ColType.INT64))}
         elif stmt.generator == "tpch":
             sf = float(opts.get("scale factor", 0.01) or 0.01)
-            gen = TpchGenerator(sf=sf)
+            from ..storage.generator import _SEGMENTS
+
+            codes = [self.catalog.dict.encode(seg) for seg in _SEGMENTS]
+            gen = TpchGenerator(sf=sf, segment_codes=codes)
             tables = self._TPCH_TABLES
         else:
             raise PlanError(f"unsupported load generator {stmt.generator}")
@@ -640,7 +643,8 @@ class Coordinator:
             self.storage[gid].append(batch, ts)
         for mv_gid, df, src_gids in self.dataflows:
             deltas = {g: env[g] for g in src_gids if g in env}
-            if not deltas:
+            if not deltas and not df.has_temporal:
+                # quiet dataflow; temporal ones must still see time pass
                 df.frontier = ts + 1
                 continue
             results = df.step(ts, deltas)

@@ -153,6 +153,17 @@ class ArrangeBy:
 
 
 @dataclass(frozen=True)
+class TemporalFilter:
+    """Validity-window filter: emit +row at window start, schedule -row at
+    window end (reference: temporal filters design doc; the pending queue is
+    the temporal-bucketing analogue, extensions/temporal_bucket.rs)."""
+
+    input: Any
+    lowers: tuple
+    uppers: tuple
+
+
+@dataclass(frozen=True)
 class LetRec:
     """Iterative scope: bindings reference each other via Get(rec_id) and are
     iterated to fixpoint within each outer tick (reference: render.rs:887

@@ -344,6 +344,113 @@ class TopKNode(Node):
         self.arr.compact(since)
 
 
+class TemporalFilterNode(Node):
+    """Validity windows: emit +row when its window opens, −row when it closes.
+
+    Future events wait in a pending batch whose times are the scheduled event
+    times; every tick flushes events ≤ tick (the temporal-bucketing shape,
+    reference extensions/temporal_bucket.rs). Runs every tick even without
+    input — the passage of time alone retracts expired rows.
+    """
+
+    def __init__(self, expr):
+        self.lowers = tuple(expr.lowers)
+        self.uppers = tuple(expr.uppers)
+        self.pending: Optional[UpdateBatch] = None
+
+    def _windows(self, batch: UpdateBatch):
+        from ..expr.scalar import eval_expr
+        from ..repr.batch import PAD_TIME
+
+        cols = list(batch.vals)
+        n = batch.cap
+        start = jnp.zeros((n,), dtype=jnp.uint64)
+        for e in self.lowers:
+            v, _err = eval_expr(e, cols, n)
+            v = jnp.maximum(v, 0).astype(jnp.uint64)
+            start = jnp.maximum(start, v)
+        end = jnp.full((n,), PAD_TIME, dtype=jnp.uint64)
+        for e in self.uppers:
+            v, _err = eval_expr(e, cols, n)
+            v = jnp.maximum(v, 0).astype(jnp.uint64)
+            end = jnp.minimum(end, v)
+        # a row's events: +d at max(start, row time), −d at end (if finite)
+        start = jnp.maximum(start, batch.times)
+        return start, end
+
+    def step(self, tick, ins):
+        from ..repr.batch import PAD_TIME
+        from ..repr.hashing import PAD_HASH
+
+        errs = None
+        d = ins[0] if ins else None
+        if d is not None:
+            oks, errs = d
+            if oks is not None:
+                start, end = self._windows(oks)
+                live = oks.live & (start < end)
+                plus = UpdateBatch(
+                    jnp.where(live, oks.hashes, PAD_HASH),
+                    oks.keys,
+                    oks.vals,
+                    jnp.where(live, start, PAD_TIME),
+                    jnp.where(live, oks.diffs, 0),
+                )
+                has_end = live & (end != PAD_TIME)
+                minus = UpdateBatch(
+                    jnp.where(has_end, oks.hashes, PAD_HASH),
+                    oks.keys,
+                    oks.vals,
+                    jnp.where(has_end, end, PAD_TIME),
+                    jnp.where(has_end, -oks.diffs, 0),
+                )
+                events = UpdateBatch.concat(plus, minus)
+                self.pending = (
+                    events
+                    if self.pending is None
+                    else UpdateBatch.concat(self.pending, events)
+                )
+        if self.pending is None:
+            return None if errs is None else (None, errs)
+        # flush events due at or before this tick
+        due = self.pending.live & (self.pending.times <= jnp.uint64(tick))
+        n_due = int(jnp.sum(due))
+        if n_due == 0:
+            out = None
+        else:
+            p = self.pending
+            out = consolidate(
+                UpdateBatch(
+                    jnp.where(due, p.hashes, PAD_HASH),
+                    p.keys,
+                    p.vals,
+                    p.times,
+                    jnp.where(due, p.diffs, 0),
+                )
+            )
+            remaining = consolidate(
+                UpdateBatch(
+                    jnp.where(due, PAD_HASH, p.hashes),
+                    p.keys,
+                    p.vals,
+                    jnp.where(due, PAD_TIME, p.times),
+                    jnp.where(due, 0, p.diffs),
+                )
+            )
+            n_rem = int(remaining.count())
+            self.pending = (
+                None if n_rem == 0 else remaining.with_capacity(bucket_cap(n_rem))
+            )
+        if out is None and errs is None:
+            return None
+        return out, errs
+
+    def state_info(self):
+        n = 0 if self.pending is None else int(self.pending.count())
+        cap = 0 if self.pending is None else self.pending.cap
+        return [("temporal_pending", 1, cap, n)]
+
+
 class LetRecNode(Node):
     """Iterate bindings to fixpoint within each outer tick.
 
@@ -458,6 +565,7 @@ class Dataflow:
 
     def __init__(self, desc: lir.DataflowDescription):
         self.desc = desc
+        self.has_temporal = False  # temporal filters need stepping every tick
         self.builds: list = []  # (obj_id, [(node, input_refs)], out_ref)
         self.dtypes: dict[str, tuple] = {}
         for sid, dts in desc.source_imports.items():
@@ -556,6 +664,11 @@ class Dataflow:
         if isinstance(e, lir.LetRec):
             ops.append((LetRecNode(e), list(e.external_ids)))
             return len(ops) - 1
+        if isinstance(e, lir.TemporalFilter):
+            ref = self._render(e.input, ops)
+            self.has_temporal = True
+            ops.append((TemporalFilterNode(e), [ref]))
+            return len(ops) - 1
         raise NotImplementedError(f"render: {type(e).__name__}")
 
     def _infer_dtypes(self, expr) -> tuple:
@@ -598,6 +711,8 @@ class Dataflow:
             return tuple(cols)
         if isinstance(e, lir.LetRec):
             return tuple(e.body_dtypes)
+        if isinstance(e, lir.TemporalFilter):
+            return self._infer_dtypes(e.input)
         raise NotImplementedError(f"dtypes: {type(e).__name__}")
 
     # -- execution ---------------------------------------------------------

@@ -109,6 +109,18 @@ class MirDistinct:
 
 
 @dataclass(frozen=True)
+class MirTemporalFilter:
+    """Temporal filter: each row is valid while max(lowers) <= mz_now() <
+    min(uppers); the operator schedules its own future retractions
+    (reference: doc/developer/design/20210426_temporal_filters.md,
+    extensions/temporal_bucket.rs)."""
+
+    input: Any
+    lowers: tuple  # ScalarExprs over input cols (validity start, inclusive)
+    uppers: tuple  # ScalarExprs over input cols (validity end, exclusive)
+
+
+@dataclass(frozen=True)
 class MirLetRec:
     """WITH MUTUALLY RECURSIVE: bindings may reference each other (and
     themselves) via MirGet of their rec ids; evaluated to fixpoint per
@@ -146,13 +158,15 @@ def arity(e: MirExpr) -> int:
         return arity(e.inputs[0])
     if isinstance(e, MirLetRec):
         return arity(e.body)
+    if isinstance(e, MirTemporalFilter):
+        return arity(e.input)
     raise TypeError(f"not a MirExpr: {e!r}")
 
 
 def children(e: MirExpr) -> tuple:
     if isinstance(e, (MirConstant, MirGet)):
         return ()
-    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct)):
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
         return (e.input,)
     if isinstance(e, (MirJoin, MirUnion)):
         return tuple(e.inputs)
@@ -181,7 +195,7 @@ def collect_get_ids(e: MirExpr) -> set:
 def with_children(e: MirExpr, new: tuple) -> MirExpr:
     if isinstance(e, (MirConstant, MirGet)):
         return e
-    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct)):
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
         return replace(e, input=new[0])
     if isinstance(e, (MirJoin, MirUnion)):
         return replace(e, inputs=tuple(new))

@@ -117,14 +117,14 @@ def q3() -> DataflowDescription:
     )
 
 
-def q3_oracle(customer, orders, lineitem) -> dict:
+def q3_oracle(customer, orders, lineitem, building_code: int = BUILDING) -> dict:
     """Brute-force Q3 over host column tuples -> {group: revenue}."""
     import numpy as np
 
     ck, seg, _ = customer
     ok, ock, od, sp = orders
     lk, ep, dc, sd, _, _ = lineitem
-    building = set(ck[seg == BUILDING].tolist())
+    building = set(ck[seg == building_code].tolist())
     omask = od < Q3_DATE
     o_by_key = {}
     for i in np.nonzero(omask)[0]:

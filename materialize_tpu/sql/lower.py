@@ -71,6 +71,8 @@ class Lowerer:
             for gid, dts, _b in e.bindings:
                 self.env[gid] = tuple(dts)
             return self.dtypes(e.body)
+        if isinstance(e, mir.MirTemporalFilter):
+            return self.dtypes(e.input)
         raise TypeError(f"dtypes: {type(e).__name__}")
 
     # -- lowering -------------------------------------------------------------
@@ -139,6 +141,10 @@ class Lowerer:
             )
         if isinstance(e, mir.MirUnion):
             return lir.Union(tuple(self.lower(i) for i in e.inputs))
+        if isinstance(e, mir.MirTemporalFilter):
+            return lir.TemporalFilter(
+                self.lower(e.input), tuple(e.lowers), tuple(e.uppers)
+            )
         if isinstance(e, mir.MirLetRec):
             rec_ids = set()
             for gid, dts, _b in e.bindings:

@@ -441,6 +441,8 @@ class Planner:
             conjuncts.extend(_split_and(p))
         if sel.where is not None:
             conjuncts.extend(_split_and(sel.where))
+        temporal = [c for c in conjuncts if _contains_mz_now(c)]
+        conjuncts = [c for c in conjuncts if not _contains_mz_now(c)]
         equivs: list[set] = []
         residual = []
         for c in conjuncts:
@@ -467,6 +469,8 @@ class Planner:
         for c in residual:
             p, _t = self.plan_scalar(c, scope)
             rel = mir.MirFilter(rel, (p,))
+        if temporal:
+            rel = self._plan_temporal(rel, temporal, scope)
 
         # 3. aggregates?
         has_group = bool(sel.group_by)
@@ -505,6 +509,50 @@ class Planner:
         if sel.distinct:
             rel = mir.MirDistinct(rel)
         return rel, out_scope
+
+    def _plan_temporal(self, rel, temporal, scope: Scope):
+        """mz_now() comparisons → validity windows (MirTemporalFilter).
+
+        mz_now() <= e  →  valid until e+1     mz_now() >= e  →  valid from e
+        mz_now() <  e  →  valid until e       mz_now() >  e  →  valid from e+1
+        (mirrored when mz_now() is on the right side).
+        """
+        lowers, uppers = [], []
+        for c in temporal:
+            if isinstance(c, ast.Between) and _is_mz_now(c.expr) and not c.negated:
+                lo, _ = self.plan_scalar(c.low, scope)
+                hi, _ = self.plan_scalar(c.high, scope)
+                lowers.append(lo)
+                uppers.append(CallBinary("add", hi, Literal(1)))
+                continue
+            if not isinstance(c, ast.BinaryOp):
+                raise PlanError("mz_now() only supported in comparison predicates")
+            lhs_now = _is_mz_now(c.left)
+            rhs_now = _is_mz_now(c.right)
+            if lhs_now == rhs_now:
+                raise PlanError("mz_now() must appear alone on one side of a comparison")
+            other = c.right if lhs_now else c.left
+            if _contains_mz_now(other):
+                raise PlanError("mz_now() must appear alone on one side of a comparison")
+            e, _t = self.plan_scalar(other, scope)
+            op = c.op
+            if rhs_now:  # e OP mz_now() → mz_now() flip(OP) e
+                op = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}[op]
+            plus1 = CallBinary("add", e, Literal(1))
+            if op == "<=":
+                uppers.append(plus1)
+            elif op == "<":
+                uppers.append(e)
+            elif op == ">=":
+                lowers.append(e)
+            elif op == ">":
+                lowers.append(plus1)
+            elif op == "=":
+                lowers.append(e)
+                uppers.append(plus1)
+            else:
+                raise PlanError(f"mz_now() unsupported with operator {op}")
+        return mir.MirTemporalFilter(rel, tuple(lowers), tuple(uppers))
 
     def _flatten_from(self, f, factors, scopes, on_preds):
         if isinstance(f, ast.TableRef):
@@ -775,6 +823,26 @@ def _split_and(e):
     if isinstance(e, ast.BinaryOp) and e.op == "and":
         return _split_and(e.left) + _split_and(e.right)
     return [e]
+
+
+def _is_mz_now(e) -> bool:
+    return isinstance(e, ast.FuncCall) and e.name == "mz_now"
+
+
+def _contains_mz_now(e) -> bool:
+    if _is_mz_now(e):
+        return True
+    if isinstance(e, ast.BinaryOp):
+        return _contains_mz_now(e.left) or _contains_mz_now(e.right)
+    if isinstance(e, ast.UnaryOp):
+        return _contains_mz_now(e.expr)
+    if isinstance(e, ast.FuncCall):
+        return any(_contains_mz_now(a) for a in e.args)
+    if isinstance(e, ast.Cast):
+        return _contains_mz_now(e.expr)
+    if isinstance(e, (ast.Between,)):
+        return _contains_mz_now(e.expr) or _contains_mz_now(e.low) or _contains_mz_now(e.high)
+    return False
 
 
 def _default_name(e) -> str:

@@ -141,9 +141,16 @@ class TpchGenerator:
     fixed-point i64 cents; dates are day numbers (date_num).
     """
 
-    def __init__(self, sf: float = 0.01, seed: int = 0):
+    def __init__(self, sf: float = 0.01, seed: int = 0, segment_codes=None):
         self.sf = sf
         self.rng = np.random.default_rng(seed)
+        # c_mktsegment: raw 0..4 indices into _SEGMENTS by default; a caller
+        # with a string dictionary passes its codes so SQL 'BUILDING' matches
+        self.segment_codes = (
+            np.asarray(segment_codes, dtype=np.int64)
+            if segment_codes is not None
+            else np.arange(5, dtype=np.int64)
+        )
         self.n_customer = max(int(150_000 * sf), 10)
         self.n_orders = max(int(1_500_000 * sf), 20)
         self.n_part = max(int(200_000 * sf), 10)
@@ -156,7 +163,7 @@ class TpchGenerator:
     def initial(self) -> TpchTables:
         rng = np.random.default_rng(12345)
         custkey = np.arange(self.n_customer, dtype=np.int64)
-        mktsegment = rng.integers(0, 5, self.n_customer).astype(np.int64)
+        mktsegment = self.segment_codes[rng.integers(0, 5, self.n_customer)]
         nationkey = rng.integers(0, 25, self.n_customer).astype(np.int64)
 
         orderkey = np.arange(self.n_orders, dtype=np.int64)

@@ -1,0 +1,86 @@
+"""Temporal filters: mz_now() validity windows with self-scheduled retractions."""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+
+
+@pytest.fixture
+def coord():
+    return Coordinator()
+
+
+def tick(coord):
+    """Advance logical time by one (an empty commit)."""
+    ts = coord.oracle.write_ts()
+    coord._apply_writes({}, ts)
+    return ts
+
+
+def test_rows_expire(coord):
+    coord.execute("CREATE TABLE events (id int, expires int)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW live AS SELECT id FROM events WHERE mz_now() < expires"
+    )
+    coord.execute("INSERT INTO events VALUES (1, 100), (2, 4)")  # ts=1
+    assert coord.execute("SELECT id FROM live ORDER BY id").rows == [(1,), (2,)]
+    tick(coord)  # ts=2
+    tick(coord)  # ts=3 (row 2 window [1,4) still open)
+    assert coord.execute("SELECT id FROM live ORDER BY id").rows == [(1,), (2,)]
+    tick(coord)  # ts=4: row 2's window closes
+    assert coord.execute("SELECT id FROM live").rows == [(1,)]
+
+
+def test_rows_appear_in_future(coord):
+    coord.execute("CREATE TABLE events (id int, starts int)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW upcoming AS SELECT id FROM events WHERE mz_now() >= starts"
+    )
+    coord.execute("INSERT INTO events VALUES (1, 0), (2, 5)")  # ts=1
+    assert coord.execute("SELECT id FROM upcoming").rows == [(1,)]
+    for _ in range(4):
+        tick(coord)
+    assert coord.execute("SELECT id FROM upcoming ORDER BY id").rows == [(1,), (2,)]
+
+
+def test_window_between(coord):
+    coord.execute("CREATE TABLE w (id int, lo int, hi int)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW active AS SELECT id FROM w WHERE mz_now() BETWEEN lo AND hi"
+    )
+    coord.execute("INSERT INTO w VALUES (1, 2, 4)")  # ts=1: not yet active
+    assert coord.execute("SELECT id FROM active").rows == []
+    tick(coord)  # ts=2: window opens
+    assert coord.execute("SELECT id FROM active").rows == [(1,)]
+    tick(coord)  # 3
+    tick(coord)  # 4 (still active: BETWEEN is inclusive)
+    assert coord.execute("SELECT id FROM active").rows == [(1,)]
+    tick(coord)  # 5: closed
+    assert coord.execute("SELECT id FROM active").rows == []
+
+
+def test_aggregation_over_temporal(coord):
+    coord.execute("CREATE TABLE sess (user_id int, until int)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW n_live AS SELECT count(*) AS n FROM sess WHERE mz_now() < until"
+    )
+    coord.execute("INSERT INTO sess VALUES (1, 10), (2, 4), (3, 4)")
+    assert coord.execute("SELECT * FROM n_live").rows == [(3,)]
+    tick(coord)
+    tick(coord)
+    tick(coord)  # ts=4: two sessions expire together
+    assert coord.execute("SELECT * FROM n_live").rows == [(1,)]
+
+
+def test_retracted_row_cancels_pending(coord):
+    coord.execute("CREATE TABLE e (id int, expires int)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW live AS SELECT id FROM e WHERE mz_now() < expires"
+    )
+    coord.execute("INSERT INTO e VALUES (1, 10)")
+    coord.execute("DELETE FROM e WHERE id = 1")
+    assert coord.execute("SELECT id FROM live").rows == []
+    # advance past nothing in particular: no spurious rows reappear
+    for _ in range(3):
+        tick(coord)
+    assert coord.execute("SELECT id FROM live").rows == []
