@@ -26,6 +26,14 @@ def cmd_serve(args) -> None:
     coord = Coordinator(data_dir=args.data_dir)
     httpd = serve(coord, host=args.host, port=args.port)
     print(f"materialize_tpu listening on http://{args.host}:{args.port}", flush=True)
+    if args.pg_port:
+        from .frontend.pgwire import serve_pgwire
+
+        serve_pgwire(
+            coord, host=args.host, port=args.pg_port,
+            lock=httpd.RequestHandlerClass.lock,
+        )
+        print(f"pgwire listening on {args.host}:{args.pg_port}", flush=True)
     if args.advance_every > 0:
         def ticker():
             while True:
@@ -110,6 +118,7 @@ def main() -> None:
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=6875)
     s.add_argument("--data-dir", default=None)
+    s.add_argument("--pg-port", type=int, default=6877)
     s.add_argument("--advance-every", type=float, default=0.0)
     s.add_argument("--rows", type=int, default=100)
     s.set_defaults(fn=cmd_serve)

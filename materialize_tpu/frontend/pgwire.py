@@ -1,0 +1,215 @@
+"""pgwire — PostgreSQL wire protocol (v3) frontend.
+
+The analogue of the reference's `mz-pgwire` (src/pgwire/src/server.rs:82
+handle_connection, protocol.rs:145 run): startup handshake (SSLRequest
+politely declined, cleartext), simple-query protocol with text-format
+results, per-statement CommandComplete tags, ErrorResponse + ReadyForQuery
+recovery. Extended query protocol (parse/bind/execute) is a later round.
+
+Every real postgres client (psql, psycopg, JDBC) speaking simple queries can
+talk to this.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+from ..adapter import Coordinator, ExecResult
+
+_SSL_REQUEST = 80877103
+_CANCEL_REQUEST = 80877102
+_GSSENC_REQUEST = 80877104
+_PROTO_V3 = 196608
+
+# pg type OIDs (reference: mz-pgrepr oid mapping)
+_OID_BOOL = 16
+_OID_INT8 = 20
+_OID_TEXT = 25
+_OID_FLOAT8 = 701
+_OID_NUMERIC = 1700
+
+
+def _msg(tag: bytes, payload: bytes) -> bytes:
+    return tag + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _cstr(s: str) -> bytes:
+    return s.encode() + b"\x00"
+
+
+class PgConnection:
+    def __init__(self, sock: socket.socket, coordinator: Coordinator, lock):
+        self.sock = sock
+        self.coord = coordinator
+        self.lock = lock
+
+    # -- startup ---------------------------------------------------------------
+    def run(self) -> None:
+        try:
+            if not self._startup():
+                return
+            self._send_ready()
+            while True:
+                tag, payload = self._read_message()
+                if tag is None or tag == b"X":
+                    break
+                if tag == b"Q":
+                    sql = payload[:-1].decode()
+                    self._simple_query(sql)
+                elif tag in (b"P", b"B", b"E", b"D", b"S", b"C"):
+                    self._send_error("0A000", "extended query protocol not supported yet")
+                    self._send_ready()
+                else:
+                    self._send_error("08P01", f"unexpected message {tag!r}")
+                    self._send_ready()
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+    def _startup(self) -> bool:
+        while True:
+            head = self._read_exact(4)
+            if head is None:
+                return False
+            (n,) = struct.unpack(">I", head)
+            body = self._read_exact(n - 4)
+            if body is None:
+                return False
+            (code,) = struct.unpack(">I", body[:4])
+            if code in (_SSL_REQUEST, _GSSENC_REQUEST):
+                self.sock.sendall(b"N")  # no TLS; client retries cleartext
+                continue
+            if code == _CANCEL_REQUEST:
+                return False
+            if code != _PROTO_V3:
+                self._send_error("08P01", f"unsupported protocol {code}")
+                return False
+            # params: key\0value\0...\0 — accepted, unused for now
+            break
+        self.sock.sendall(_msg(b"R", struct.pack(">I", 0)))  # AuthenticationOk
+        for k, v in (
+            ("server_version", "9.5.0 materialize_tpu"),
+            ("client_encoding", "UTF8"),
+            ("DateStyle", "ISO"),
+            ("integer_datetimes", "on"),
+            ("standard_conforming_strings", "on"),
+        ):
+            self.sock.sendall(_msg(b"S", _cstr(k) + _cstr(v)))
+        self.sock.sendall(_msg(b"K", struct.pack(">II", 0, 0)))  # BackendKeyData
+        return True
+
+    # -- messages --------------------------------------------------------------
+    def _read_exact(self, n: int):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_message(self):
+        tag = self._read_exact(1)
+        if tag is None:
+            return None, None
+        head = self._read_exact(4)
+        if head is None:
+            return None, None
+        (n,) = struct.unpack(">I", head)
+        payload = self._read_exact(n - 4) if n > 4 else b""
+        return tag, payload
+
+    def _send_ready(self) -> None:
+        self.sock.sendall(_msg(b"Z", b"I"))
+
+    def _send_error(self, code: str, message: str) -> None:
+        fields = b"S" + _cstr("ERROR") + b"C" + _cstr(code) + b"M" + _cstr(message) + b"\x00"
+        self.sock.sendall(_msg(b"E", fields))
+
+    # -- queries ---------------------------------------------------------------
+    def _simple_query(self, sql: str) -> None:
+        if not sql.strip():
+            self.sock.sendall(_msg(b"I", b""))  # EmptyQueryResponse
+            self._send_ready()
+            return
+        try:
+            with self.lock:
+                results = self.coord.execute_script(sql)
+        except Exception as e:
+            self._send_error("XX000", str(e))
+            self._send_ready()
+            return
+        for r in results:
+            if r.kind == "rows":
+                self._send_row_description(r)
+                for row in r.rows:
+                    self._send_data_row(row)
+                tag = f"SELECT {len(r.rows)}"
+                self.sock.sendall(_msg(b"C", _cstr(tag)))
+            else:
+                self.sock.sendall(_msg(b"C", _cstr(r.status)))
+        self._send_ready()
+
+    def _send_row_description(self, r: ExecResult) -> None:
+        payload = struct.pack(">H", len(r.columns))
+        for i, name in enumerate(r.columns):
+            oid = _OID_TEXT
+            if r.rows:
+                v = r.rows[0][i]
+                if isinstance(v, bool):
+                    oid = _OID_BOOL
+                elif isinstance(v, int):
+                    oid = _OID_INT8
+                elif isinstance(v, float):
+                    oid = _OID_FLOAT8
+            payload += (
+                _cstr(name or f"column{i+1}")
+                + struct.pack(">IhIhih", 0, 0, oid, -1, -1, 0)
+            )
+        self.sock.sendall(_msg(b"T", payload))
+
+    def _send_data_row(self, row: tuple) -> None:
+        payload = struct.pack(">H", len(row))
+        for v in row:
+            if v is None:
+                payload += struct.pack(">i", -1)
+                continue
+            if isinstance(v, bool):
+                text = "t" if v else "f"
+            else:
+                text = str(v)
+            data = text.encode()
+            payload += struct.pack(">I", len(data)) + data
+        self.sock.sendall(_msg(b"D", payload))
+
+
+def serve_pgwire(
+    coordinator: Coordinator,
+    host: str = "127.0.0.1",
+    port: int = 6877,
+    lock: threading.Lock | None = None,
+):
+    """Start the pgwire listener (thread-per-connection); returns the server
+    socket and its accept thread (daemon)."""
+    lock = lock or threading.Lock()
+    srv = socket.create_server((host, port))
+    srv.listen(16)
+
+    def accept_loop():
+        while True:
+            try:
+                conn, _addr = srv.accept()
+            except OSError:
+                return
+            c = PgConnection(conn, coordinator, lock)
+            threading.Thread(target=c.run, daemon=True).start()
+
+    t = threading.Thread(target=accept_loop, daemon=True)
+    t.start()
+    return srv, t

@@ -1,0 +1,134 @@
+"""pgwire frontend driven by a raw protocol-v3 client (no psycopg needed)."""
+
+import socket
+import struct
+import threading
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.frontend.pgwire import serve_pgwire
+
+
+class MiniPgClient:
+    """Just enough of the wire protocol to act like psql -c."""
+
+    def __init__(self, port):
+        self.sock = socket.create_connection(("127.0.0.1", port), timeout=30)
+
+    def startup(self):
+        # try SSLRequest first, expect 'N'
+        self.sock.sendall(struct.pack(">II", 8, 80877103))
+        assert self.sock.recv(1) == b"N"
+        params = b"user\x00tester\x00database\x00materialize\x00\x00"
+        payload = struct.pack(">I", 196608) + params
+        self.sock.sendall(struct.pack(">I", len(payload) + 4) + payload)
+        msgs = self.read_until(b"Z")
+        assert any(t == b"R" for t, _ in msgs)  # AuthenticationOk
+        return msgs
+
+    def _read_exact(self, n):
+        buf = b""
+        while len(buf) < n:
+            chunk = self.sock.recv(n - len(buf))
+            assert chunk, "server hung up"
+            buf += chunk
+        return buf
+
+    def read_message(self):
+        tag = self._read_exact(1)
+        (n,) = struct.unpack(">I", self._read_exact(4))
+        return tag, self._read_exact(n - 4) if n > 4 else b""
+
+    def read_until(self, end_tag):
+        out = []
+        while True:
+            t, p = self.read_message()
+            out.append((t, p))
+            if t == end_tag:
+                return out
+
+    def query(self, sql):
+        payload = sql.encode() + b"\x00"
+        self.sock.sendall(b"Q" + struct.pack(">I", len(payload) + 4) + payload)
+        msgs = self.read_until(b"Z")
+        rows, cols, tags, errors = [], [], [], []
+        for t, p in msgs:
+            if t == b"T":
+                (ncols,) = struct.unpack(">H", p[:2])
+                off = 2
+                names = []
+                for _ in range(ncols):
+                    end = p.index(b"\x00", off)
+                    names.append(p[off:end].decode())
+                    off = end + 1 + 18
+                cols = names
+            elif t == b"D":
+                (n,) = struct.unpack(">H", p[:2])
+                off = 2
+                row = []
+                for _ in range(n):
+                    (ln,) = struct.unpack(">i", p[off : off + 4])
+                    off += 4
+                    if ln < 0:
+                        row.append(None)
+                    else:
+                        row.append(p[off : off + ln].decode())
+                        off += ln
+                rows.append(tuple(row))
+            elif t == b"C":
+                tags.append(p[:-1].decode())
+            elif t == b"E":
+                errors.append(p)
+        return rows, cols, tags, errors
+
+    def close(self):
+        self.sock.sendall(b"X" + struct.pack(">I", 4))
+        self.sock.close()
+
+
+@pytest.fixture
+def pg():
+    coord = Coordinator()
+    srv, _t = serve_pgwire(coord, port=0)
+    port = srv.getsockname()[1]
+    client = MiniPgClient(port)
+    client.startup()
+    yield client
+    client.close()
+    srv.close()
+
+
+def test_pgwire_ddl_dml_select(pg):
+    rows, cols, tags, errors = pg.query("CREATE TABLE t (a int, b text)")
+    assert tags == ["CREATE TABLE"] and not errors
+    pg.query("INSERT INTO t VALUES (1, 'x'), (2, 'y')")
+    rows, cols, tags, errors = pg.query("SELECT a, b FROM t ORDER BY a")
+    assert cols == ["a", "b"]
+    assert rows == [("1", "x"), ("2", "y")]
+    assert tags == ["SELECT 2"]
+
+
+def test_pgwire_multi_statement(pg):
+    rows, cols, tags, errors = pg.query(
+        "CREATE TABLE u (v int); INSERT INTO u VALUES (7); SELECT v FROM u"
+    )
+    assert tags == ["CREATE TABLE", "INSERT 0 1", "SELECT 1"]
+    assert rows == [("7",)]
+
+
+def test_pgwire_error_recovers(pg):
+    _rows, _cols, _tags, errors = pg.query("SELECT nope FROM nothing")
+    assert errors
+    rows, _c, tags, errors = pg.query("SELECT 1 + 1")
+    assert rows == [("2",)] and not errors
+
+
+def test_pgwire_mv_roundtrip(pg):
+    pg.query("CREATE TABLE bids (auction int, amount int)")
+    pg.query(
+        "CREATE MATERIALIZED VIEW totals AS SELECT auction, sum(amount) AS s FROM bids GROUP BY auction"
+    )
+    pg.query("INSERT INTO bids VALUES (1, 10), (1, 5)")
+    rows, cols, tags, _ = pg.query("SELECT * FROM totals")
+    assert rows == [("1", "15")]
