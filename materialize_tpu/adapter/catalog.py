@@ -64,6 +64,7 @@ class CatalogItem:
     generator: Optional[str] = None
     options: tuple = ()
     global_id: str = ""
+    append_only: bool = False  # monotonic source (unlocks Monotonic plans)
 
 
 class Catalog:

@@ -268,9 +268,14 @@ class Coordinator:
             tables = self._TPCH_TABLES
         else:
             raise PlanError(f"unsupported load generator {stmt.generator}")
+        append_only = stmt.generator == "auction" or (
+            stmt.generator == "counter" and not opts.get("max cardinality")
+        )
         gids = {}
         for tname, desc in tables.items():
-            item = self.catalog.create(CatalogItem(tname, "source", desc=desc))
+            item = self.catalog.create(
+                CatalogItem(tname, "source", desc=desc, append_only=append_only)
+            )
             self.storage[item.global_id] = StorageCollection(desc.dtypes)
             gids[tname] = item.global_id
         self.catalog.create(CatalogItem(stmt.name, "source_parent", generator=stmt.generator))
@@ -310,7 +315,9 @@ class Coordinator:
         gid = item.global_id
         src_gids = sorted(_collect_gets(rel))
         env = {g: self.storage[g].dtypes for g in src_gids}
-        desc = lower_to_dataflow(gid, rel, env, src_gids, index_key=(), as_of=0)
+        desc = lower_to_dataflow(
+            gid, rel, env, src_gids, index_key=(), as_of=0, mono_ids=self._mono_ids()
+        )
         df = Dataflow(desc)
         # hydrate: snapshot all inputs at the current read timestamp
         as_of = self.oracle.read_ts()
@@ -522,6 +529,7 @@ class Coordinator:
                     "generator": it.generator,
                     "options": it.options,
                     "global_id": it.global_id,
+                    "append_only": it.append_only,
                 }
             )
         doc = pickle.dumps(
@@ -568,7 +576,7 @@ class Coordinator:
                 d["name"], d["kind"], desc=d["desc"], query_ast=d["query_ast"],
                 index_on=d["index_on"], index_key=d["index_key"],
                 generator=d["generator"], options=d["options"],
-                global_id=d["global_id"],
+                global_id=d["global_id"], append_only=d.get("append_only", False),
             )
             self.catalog.items[item.name] = item
             if item.kind in ("table", "source"):
@@ -623,7 +631,9 @@ class Coordinator:
         gid = item.global_id
         src_gids = sorted(_collect_gets(rel))
         env = {g: self.storage[g].dtypes for g in src_gids}
-        desc = _lower(gid, rel, env, src_gids, index_key=(), as_of=0)
+        desc = _lower(
+            gid, rel, env, src_gids, index_key=(), as_of=0, mono_ids=self._mono_ids()
+        )
         df = Dataflow(desc)
         as_of = self.oracle.read_ts()
         snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
@@ -632,6 +642,11 @@ class Coordinator:
         if out is not None and out[0] is not None:
             self.storage[gid].append(out[0], as_of)
         self.dataflows.append((gid, df, src_gids))
+
+    def _mono_ids(self) -> set:
+        return {
+            i.global_id for i in self.catalog.items.values() if i.append_only
+        }
 
     # -- write propagation -----------------------------------------------------
     def _apply_writes(self, writes: dict[str, UpdateBatch], ts: int) -> None:
@@ -694,7 +709,9 @@ class Coordinator:
         if rows is None:
             src_gids = sorted(_collect_gets(rel))
             env = {g: self.storage[g].dtypes for g in src_gids}
-            desc = lower_to_dataflow("peek", rel, env, src_gids, as_of=as_of)
+            desc = lower_to_dataflow(
+                "peek", rel, env, src_gids, as_of=as_of, mono_ids=self._mono_ids()
+            )
             df = Dataflow(desc)
             snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
             df.step(as_of, snaps)

@@ -129,6 +129,7 @@ class HierarchicalReduce:
 class TopK:
     input: Any
     plan: TopKPlan
+    monotonic: bool = False  # append-only input: keep only current winners
 
 
 @dataclass(frozen=True)

@@ -344,6 +344,68 @@ class TopKNode(Node):
         self.arr.compact(since)
 
 
+class MonotonicTopKNode(Node):
+    """TopK over an append-only input: state is only the current winners.
+
+    The reference's MonotonicTop1/MonotonicTopK plans (plan/top_k.rs:28,
+    render/top_k.rs:772 thinning): with no retractions possible, the new
+    top-k of a group is always a subset of {stored winners} ∪ {new rows}, so
+    the node stores the top (offset+limit) rows per touched group instead of
+    the whole input — the input arrangement disappears entirely.
+    """
+
+    def __init__(self, tplan):
+        assert tplan.limit is not None
+        self.plan = tplan
+        self.keep = tplan.offset + tplan.limit
+        self.out_arr = Arrangement(key_cols=tplan.group_cols)
+
+    def step(self, tick, ins):
+        from ..ops.topk import distinct_keys, gather_groups, negate, topk_select
+
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        if int(jnp.sum(jnp.where(oks.live, (oks.diffs < 0).astype(jnp.int32), 0))) > 0:
+            raise RuntimeError(
+                "monotonic top-k saw a retraction; plan must use the general path"
+            )
+        keyed = arrange_batch(oks, self.plan.group_cols)
+        probes = distinct_keys(keyed)
+        vdt = tuple(v.dtype for v in keyed.vals)
+        old_kept = gather_groups(probes, self.out_arr.batches, tick, vdt)
+        cand = consolidate(UpdateBatch.concat(old_kept, keyed))
+        new_kept = topk_select(cand, self.plan.order_by, self.keep, 0, tick)
+        new_window = topk_select(
+            cand, self.plan.order_by, self.plan.limit, self.plan.offset, tick
+        )
+        old_window = topk_select(
+            old_kept, self.plan.order_by, self.plan.limit, self.plan.offset, tick
+        )
+        out = consolidate(UpdateBatch.concat(new_window, negate(old_window)))
+        state_delta = consolidate(
+            UpdateBatch.concat(new_kept, negate(_retime(old_kept, tick)))
+        )
+        self.out_arr.insert(state_delta)
+        return out, errs
+
+    def compact(self, since):
+        self.out_arr.compact(since)
+
+    def state_info(self):
+        return [
+            (
+                "monotonic_topk_winners",
+                len(self.out_arr.batches),
+                self.out_arr.total_cap(),
+                self.out_arr.count(),
+            )
+        ]
+
+
 class TemporalFilterNode(Node):
     """Validity windows: emit +row when its window opens, −row when it closes.
 
@@ -659,7 +721,10 @@ class Dataflow:
             return len(ops) - 1
         if isinstance(e, lir.TopK):
             ref = self._render(e.input, ops)
-            ops.append((TopKNode(e.plan), [ref]))
+            if getattr(e, "monotonic", False) and e.plan.limit is not None:
+                ops.append((MonotonicTopKNode(e.plan), [ref]))
+            else:
+                ops.append((TopKNode(e.plan), [ref]))
             return len(ops) - 1
         if isinstance(e, lir.LetRec):
             ops.append((LetRecNode(e), list(e.external_ids)))

@@ -28,8 +28,9 @@ F32 = np.dtype(np.float32)
 
 
 class Lowerer:
-    def __init__(self, dtypes_env: dict):
+    def __init__(self, dtypes_env: dict, mono_ids: set | None = None):
         self.env = dict(dtypes_env)
+        self.mono_ids = set(mono_ids or ())
 
     # -- dtype inference ------------------------------------------------------
     def dtypes(self, e) -> tuple:
@@ -121,6 +122,8 @@ class Lowerer:
         if isinstance(e, mir.MirReduce):
             return self.lower_reduce(e)
         if isinstance(e, mir.MirTopK):
+            from ..transform.monotonic import is_monotonic
+
             return lir.TopK(
                 self.lower(e.input),
                 TopKPlan(
@@ -129,6 +132,7 @@ class Lowerer:
                     limit=e.limit,
                     offset=e.offset,
                 ),
+                monotonic=is_monotonic(e.input, self.mono_ids),
             )
         if isinstance(e, mir.MirNegate):
             return lir.Negate(self.lower(e.input))
@@ -210,6 +214,8 @@ class Lowerer:
             b.project(tuple(key) + (n_in,))
             pre = lir.Mfp(lowered_in, b.finish())
             nk = len(key)
+            from ..transform.monotonic import is_monotonic
+
             topk = lir.TopK(
                 pre,
                 TopKPlan(
@@ -217,6 +223,7 @@ class Lowerer:
                     order_by=((nk, a.func == "max"),),
                     limit=1,
                 ),
+                monotonic=is_monotonic(e.input, self.mono_ids),
             )
             return topk
 
@@ -276,9 +283,10 @@ def lower_to_dataflow(
     source_ids: list[str],
     index_key: tuple = (),
     as_of: int = 0,
+    mono_ids: set | None = None,
 ) -> DataflowDescription:
     """Build a one-object DataflowDescription for `mir_expr`."""
-    lo = Lowerer(dtypes_env)
+    lo = Lowerer(dtypes_env, mono_ids)
     plan = lo.lower(mir_expr)
     out_dtypes = lo.dtypes(mir_expr)
     return DataflowDescription(

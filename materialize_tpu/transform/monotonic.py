@@ -1,0 +1,34 @@
+"""Monotonicity analysis: which collections only ever grow?
+
+The analogue of the reference's monotonic analysis
+(src/transform/src/monotonic.rs), which unlocks the Monotonic top-k/min/max
+render plans (plan/top_k.rs MonotonicTop1/TopK, reduce.rs ReductionMonoid):
+append-only collections never retract, so a top-k needs to remember only its
+current winners, not the whole input.
+"""
+
+from __future__ import annotations
+
+from ..expr import relation as mir
+
+
+def is_monotonic(e, mono_ids: set) -> bool:
+    """True if the collection only ever receives additions (diff > 0)."""
+    if isinstance(e, mir.MirGet):
+        return e.id in mono_ids
+    if isinstance(e, mir.MirConstant):
+        return all(d > 0 for _row, d in e.rows)
+    if isinstance(e, (mir.MirMap, mir.MirFilter, mir.MirProject)):
+        return is_monotonic(e.input, mono_ids)
+    if isinstance(e, mir.MirJoin):
+        return all(is_monotonic(i, mono_ids) for i in e.inputs)
+    if isinstance(e, mir.MirUnion):
+        return all(is_monotonic(i, mono_ids) for i in e.inputs)
+    if isinstance(e, (mir.MirDistinct, mir.MirThreshold)):
+        # distinct/threshold over additions only ever add
+        return is_monotonic(e.input, mono_ids)
+    if isinstance(e, mir.MirTemporalFilter):
+        # upper bounds schedule retractions; lower-bound-only stays monotonic
+        return not e.uppers and is_monotonic(e.input, mono_ids)
+    # Reduce/TopK/Negate/LetRec outputs can retract
+    return False

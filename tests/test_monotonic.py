@@ -1,0 +1,75 @@
+"""Monotonic (append-only) top-k fast path vs the general path."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.transform.monotonic import is_monotonic
+
+
+def test_analysis():
+    from materialize_tpu.expr import relation as mir
+
+    g = mir.MirGet("src", 2)
+    assert is_monotonic(g, {"src"})
+    assert not is_monotonic(g, set())
+    assert is_monotonic(mir.MirFilter(g, ()), {"src"})
+    assert not is_monotonic(mir.MirNegate(g), {"src"})
+    assert not is_monotonic(mir.MirReduce(g, (0,), ()), {"src"})
+
+
+def test_monotonic_topk_through_sql():
+    c = Coordinator()
+    c.execute("CREATE SOURCE auction_house FROM LOAD GENERATOR AUCTION")
+    c.execute(
+        """CREATE MATERIALIZED VIEW top_bids AS
+           SELECT auction_id, amount FROM bids ORDER BY amount DESC LIMIT 3"""
+    )
+    # the monotonic plan must have been chosen
+    _gid, df, _src = c.dataflows[-1]
+    from materialize_tpu.dataflow.runtime import MonotonicTopKNode
+
+    kinds = [t for _o, _i, t, _e, _n in df.operator_info()]
+    assert "MonotonicTopKNode" in kinds
+
+    bids = []
+    for _ in range(4):
+        c.advance(25)
+    rows = c.execute("SELECT amount FROM top_bids ORDER BY amount DESC").rows
+    all_bids = c.execute("SELECT amount FROM bids").rows
+    want = sorted((a for (a,) in all_bids), reverse=True)[:3]
+    assert [a for (a,) in rows] == want
+
+
+def test_monotonic_max_per_group():
+    c = Coordinator()
+    c.execute("CREATE SOURCE auction_house FROM LOAD GENERATOR AUCTION")
+    c.execute(
+        """CREATE MATERIALIZED VIEW maxes AS
+           SELECT auction_id, max(amount) AS m FROM bids GROUP BY auction_id"""
+    )
+    _gid, df, _src = c.dataflows[-1]
+    kinds = [t for _o, _i, t, _e, _n in df.operator_info()]
+    assert "MonotonicTopKNode" in kinds
+    for _ in range(3):
+        c.advance(20)
+    got = dict(c.execute("SELECT * FROM maxes").rows)
+    want: dict = {}
+    for (auc, amt) in c.execute("SELECT auction_id, amount FROM bids").rows:
+        want[auc] = max(want.get(auc, 0), amt)
+    assert got == want
+
+
+def test_general_path_for_tables():
+    """Tables can retract: the general top-k path must be used and stay right."""
+    c = Coordinator()
+    c.execute("CREATE TABLE t (g int, v int)")
+    c.execute(
+        "CREATE MATERIALIZED VIEW top1 AS SELECT g, v FROM t ORDER BY v DESC LIMIT 1"
+    )
+    _gid, df, _src = c.dataflows[-1]
+    kinds = [t for _o, _i, t, _e, _n in df.operator_info()]
+    assert "MonotonicTopKNode" not in kinds
+    c.execute("INSERT INTO t VALUES (1, 10), (2, 50)")
+    c.execute("DELETE FROM t WHERE v = 50")
+    assert c.execute("SELECT * FROM top1").rows == [(1, 10)]
