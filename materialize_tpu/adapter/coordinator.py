@@ -115,6 +115,12 @@ class Coordinator:
         return [self.execute_stmt(s) for s in parse_statements(sql)]
 
     def execute_stmt(self, stmt) -> ExecResult:
+        from ..utils.tracing import TRACER
+
+        with TRACER.span(f"execute:{type(stmt).__name__}"):
+            return self._execute_stmt_inner(stmt)
+
+    def _execute_stmt_inner(self, stmt) -> ExecResult:
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.CreateSource):
@@ -144,6 +150,10 @@ class Coordinator:
                 self.configs.set(stmt.name, stmt.value)
             except KeyError as e:
                 raise PlanError(str(e))
+            if stmt.name == "log_filter":
+                from ..utils.tracing import TRACER
+
+                TRACER.set_filter(self.configs.get("log_filter"))
             return ExecResult("status", status="SET")
         if isinstance(stmt, ast.Update):
             return self._update(stmt)
@@ -155,7 +165,7 @@ class Coordinator:
         src/compute/src/sink/subscribe.rs). Returns a subscription id; poll
         with `poll_subscription` for (data…, ts, diff) deltas."""
         pq = self.planner.plan_query(stmt.query)
-        rel = optimize(pq.mir)
+        rel = optimize(pq.mir, self.configs)
         if isinstance(rel, mir.MirGet) and any(
             g == rel.id for g, _df, _s in self.dataflows
         ) or (isinstance(rel, mir.MirGet) and rel.id in self.storage):
@@ -255,6 +265,18 @@ class Coordinator:
         if stmt.generator == "auction":
             gen = AuctionGenerator(seed=0, dict_=self.catalog.dict)
             tables = self._AUCTION_TABLES
+        elif stmt.generator == "key_value":
+            from ..storage.upsert import KeyValueGenerator
+
+            gen = KeyValueGenerator(
+                keys=int(opts.get("keys", 100) or 100),
+                seed=int(opts.get("seed", 0) or 0),
+            )
+            tables = {
+                "key_value": RelationDesc.of(
+                    ("key", ColType.INT64), ("value", ColType.INT64), key=(0,)
+                )
+            }
         elif stmt.generator == "counter":
             maxc = opts.get("max cardinality")
             gen = CounterGenerator(int(maxc) if maxc else None)
@@ -690,6 +712,8 @@ class Coordinator:
                 batches = gen.next_tick(ts, n_rows)
             elif isinstance(gen, CounterGenerator):
                 batches = gen.next_tick(ts, 1)
+            elif hasattr(gen, "upsert"):  # KeyValueGenerator
+                batches = gen.next_tick(ts, n_rows)
             else:
                 batches = gen.refresh(ts)
             for t, b in batches.items():
@@ -768,7 +792,11 @@ class Coordinator:
         inner = stmt.statement
         if isinstance(inner, ast.SelectStatement):
             pq = self.planner.plan_query(inner.query)
-            rel = optimize(pq.mir) if stmt.stage in ("optimized", "physical") else pq.mir
+            rel = (
+                optimize(pq.mir, self.configs)
+                if stmt.stage in ("optimized", "physical")
+                else pq.mir
+            )
             text = explain_mir(rel)
             return ExecResult("rows", rows=[(line,) for line in text.splitlines()], columns=("plan",))
         raise PlanError("EXPLAIN supports SELECT only")

@@ -82,6 +82,10 @@ INDEX_FAST_PATH = Config(
 INTROSPECTION = Config(
     "enable_introspection", True, "expose mz_* introspection relations"
 )
+LOG_FILTER = Config(
+    "log_filter", "off", "tracing emission level: off | info | debug "
+    "(the ALTER SYSTEM SET log_filter analogue, doc/developer/tracing.md)"
+)
 
 ALL_CONFIGS = [
     ENABLE_DELTA_JOIN,
@@ -89,6 +93,7 @@ ALL_CONFIGS = [
     LSM_MERGE_RATIO,
     INDEX_FAST_PATH,
     INTROSPECTION,
+    LOG_FILTER,
 ]
 
 

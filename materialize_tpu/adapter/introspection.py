@@ -46,6 +46,12 @@ INTROSPECTION_TABLES = {
         ("elapsed_ns", ColType.INT64),
         ("invocations", ColType.INT64),
     ),
+    "mz_trace_spans": _desc(
+        ("id", ColType.INT64),
+        ("parent", ColType.INT64),
+        ("name", ColType.STRING),
+        ("duration_ns", ColType.INT64),
+    ),
     "mz_arrangement_sizes": _desc(
         ("dataflow", ColType.STRING),
         ("operator_id", ColType.INT64),
@@ -100,6 +106,14 @@ def introspection_rows(coord, name: str) -> list[tuple]:
             for obj, op_i, typ, el, inv in df.operator_info():
                 out.append((gid, op_i, typ, el, inv))
         return out
+    if name == "mz_trace_spans":
+        from ..utils.tracing import TRACER
+
+        return [
+            (s.id, s.parent, s.name, s.duration_ns)
+            for s in TRACER.recent()
+            if s.duration_ns >= 0
+        ]
     if name == "mz_arrangement_sizes":
         out = []
         for gid, df, _src in coord.dataflows:

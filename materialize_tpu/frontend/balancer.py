@@ -1,0 +1,74 @@
+"""balancerd — stateless ingress router.
+
+The analogue of the reference's `mz-balancerd` (src/balancerd/src/lib.rs:9-12):
+a connection-level TCP proxy that spreads pgwire/HTTP clients across backend
+environments. No protocol awareness needed — it splices bytes both ways and
+removes itself from the failure story (stateless, restartable).
+"""
+
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+
+
+class Balancer:
+    def __init__(self, backends: list[tuple], host: str = "127.0.0.1", port: int = 0):
+        self.backends = list(backends)
+        self._rr = itertools.count()
+        self.srv = socket.create_server((host, port))
+        self.srv.listen(64)
+        self.port = self.srv.getsockname()[1]
+        self._thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._thread.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self.srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._proxy, args=(conn,), daemon=True).start()
+
+    def _pick_backend(self):
+        # round-robin with failover: try every backend once
+        n = len(self.backends)
+        start = next(self._rr)
+        for k in range(n):
+            addr = self.backends[(start + k) % n]
+            try:
+                return socket.create_connection(addr, timeout=5)
+            except OSError:
+                continue
+        return None
+
+    def _proxy(self, client: socket.socket):
+        upstream = self._pick_backend()
+        if upstream is None:
+            client.close()
+            return
+
+        def pump(src, dst):
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+            except OSError:
+                pass
+            finally:
+                for s in (src, dst):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        threading.Thread(target=pump, args=(client, upstream), daemon=True).start()
+        pump(upstream, client)
+        client.close()
+        upstream.close()
+
+    def close(self):
+        self.srv.close()

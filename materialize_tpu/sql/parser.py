@@ -145,6 +145,9 @@ class Parser:
             self.expect_kw("load")
             self.expect_kw("generator")
             gen = self.ident()
+            if gen == "key" and self.peek().value == "value":
+                self.next()
+                gen = "key_value"
             options = []
             if self.eat_op("("):
                 while not self.at_op(")"):

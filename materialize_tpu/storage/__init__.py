@@ -1,3 +1,11 @@
-from .generator import AuctionGenerator, TpchGenerator, date_num
+from .generator import AuctionGenerator, CounterGenerator, TpchGenerator, date_num
+from .upsert import KeyValueGenerator, UpsertState
 
-__all__ = ["AuctionGenerator", "TpchGenerator", "date_num"]
+__all__ = [
+    "AuctionGenerator",
+    "CounterGenerator",
+    "TpchGenerator",
+    "date_num",
+    "KeyValueGenerator",
+    "UpsertState",
+]

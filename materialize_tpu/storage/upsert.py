@@ -1,0 +1,85 @@
+"""UPSERT envelope: keyed last-write-wins streams → retraction diffs.
+
+The analogue of the reference's UPSERT envelope state machine
+(src/storage/src/upsert.rs:26,60): sources that emit (key → value | tombstone)
+records become differential collections by retracting each key's previous
+value. The reference spills this state to RocksDB (C++); here it is a host
+hash map (the same host-side role), with the emitted diffs flowing to the
+device engine as ordinary update batches.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..repr.batch import UpdateBatch
+
+
+class UpsertState:
+    """key tuple -> value tuple; None value = tombstone (delete)."""
+
+    def __init__(self) -> None:
+        self.state: dict[tuple, tuple] = {}
+
+    def apply(self, keys: list[tuple], values: list, tick: int, n_val_cols: int,
+              key_dtypes, val_dtypes) -> UpdateBatch:
+        """Convert upsert records to (row, tick, ±1) diffs.
+
+        Later records in the same batch win (last-write-wins in offset order,
+        upsert.rs semantics).
+        """
+        # collapse to the final record per key within the batch
+        final: dict[tuple, tuple | None] = {}
+        for k, v in zip(keys, values):
+            final[k] = v
+        out_rows: list[tuple] = []
+        out_diffs: list[int] = []
+        for k, v in final.items():
+            old = self.state.get(k)
+            if v is None:
+                if old is not None:
+                    out_rows.append(k + old)
+                    out_diffs.append(-1)
+                    del self.state[k]
+                continue
+            if old == v:
+                continue
+            if old is not None:
+                out_rows.append(k + old)
+                out_diffs.append(-1)
+            out_rows.append(k + v)
+            out_diffs.append(1)
+            self.state[k] = v
+        n = len(out_rows)
+        nk = len(key_dtypes)
+        cols = tuple(
+            np.array([r[i] for r in out_rows], dtype=dt)
+            for i, dt in enumerate(tuple(key_dtypes) + tuple(val_dtypes))
+        )
+        return UpdateBatch.build(
+            (), cols, np.full(n, tick, dtype=np.uint64), np.array(out_diffs, dtype=np.int64)
+        )
+
+
+class KeyValueGenerator:
+    """KEY VALUE load generator (load_generator.rs KeyValueLoadGenerator):
+    a fixed key space receiving randomized value overwrites — the canonical
+    UPSERT workload. Emits via UpsertState, so downstream sees clean diffs.
+    """
+
+    def __init__(self, keys: int = 100, seed: int = 0, tombstone_frac: float = 0.05):
+        self.n_keys = keys
+        self.rng = np.random.default_rng(seed)
+        self.tombstone_frac = tombstone_frac
+        self.upsert = UpsertState()
+
+    def next_tick(self, tick: int, n_records: int = 50) -> dict[str, UpdateBatch]:
+        ks = self.rng.integers(0, self.n_keys, n_records)
+        vals = self.rng.integers(0, 1_000_000, n_records)
+        tomb = self.rng.random(n_records) < self.tombstone_frac
+        keys = [(int(k),) for k in ks]
+        values = [None if t else (int(v),) for v, t in zip(vals, tomb)]
+        batch = self.upsert.apply(
+            keys, values, tick, 1, (np.dtype(np.int64),), (np.dtype(np.int64),)
+        )
+        return {"key_value": batch}

@@ -1,0 +1,40 @@
+"""UPSERT envelope + KEY VALUE load generator."""
+
+import numpy as np
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.storage import UpsertState
+
+
+def test_upsert_state_machine():
+    u = UpsertState()
+    kd, vd = (np.dtype(np.int64),), (np.dtype(np.int64),)
+
+    b = u.apply([(1,), (2,)], [(10,), (20,)], 1, 1, kd, vd)
+    assert sorted(b.to_rows()) == [((1, 10), 1, 1), ((2, 20), 1, 1)]
+
+    # overwrite key 1, tombstone key 2, no-op re-write of same value
+    b = u.apply([(1,), (2,), (1,)], [(11,), None, (11,)], 2, 1, kd, vd)
+    rows = sorted(b.to_rows())
+    assert rows == [((1, 10), 2, -1), ((1, 11), 2, 1), ((2, 20), 2, -1)]
+
+    # same-batch last-write-wins
+    b = u.apply([(3,), (3,)], [(1,), (2,)], 3, 1, kd, vd)
+    assert b.to_rows() == [((3, 2), 3, 1)]
+
+
+def test_key_value_source_consistency():
+    c = Coordinator()
+    c.execute("CREATE SOURCE kv FROM LOAD GENERATOR KEY VALUE (KEYS 20)")
+    c.execute(
+        "CREATE MATERIALIZED VIEW agg AS SELECT count(*) AS n, sum(value) AS s FROM key_value"
+    )
+    gen = c.generators[0][0]
+    for _ in range(6):
+        c.advance(30)
+    rows = c.execute("SELECT key, value FROM key_value ORDER BY key").rows
+    # collection contents == upsert state exactly (one row per live key)
+    want = sorted((k[0], v[0]) for k, v in gen.upsert.state.items())
+    assert rows == want
+    n, s = c.execute("SELECT * FROM agg").rows[0]
+    assert n == len(want) and s == sum(v for _k, v in want)
