@@ -1,0 +1,104 @@
+"""Zippy-style randomized action sequences with invariant validation.
+
+The analogue of the reference's zippy framework (doc/developer/zippy.md:
+weighted random actions — ingest, DDL, restarts — with watermark validation)
+and platform-checks (write-once checks across restart scenarios): a random
+schedule of inserts/deletes/updates/DDL/restarts against a durable
+coordinator, validating after every action that
+
+  1. every materialized view equals a from-scratch recompute of its query,
+  2. restarts lose nothing.
+"""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+
+
+class Zippy:
+    def __init__(self, tmp_path, seed: int):
+        self.dir = str(tmp_path / "zippy")
+        self.coord = Coordinator(data_dir=self.dir)
+        self.rng = np.random.default_rng(seed)
+        self.next_row = 0
+        self.live_rows: dict[int, tuple] = {}  # id -> (g, v)
+        self.mv_count = 0
+        self.coord.execute("CREATE TABLE t (id int, g int, v int)")
+
+    # -- actions (weighted) ----------------------------------------------------
+    def act_insert(self):
+        n = int(self.rng.integers(1, 8))
+        rows = []
+        for _ in range(n):
+            rid = self.next_row
+            self.next_row += 1
+            g = int(self.rng.integers(0, 5))
+            v = int(self.rng.integers(-50, 50))
+            self.live_rows[rid] = (g, v)
+            rows.append(f"({rid}, {g}, {v})")
+        self.coord.execute(f"INSERT INTO t VALUES {', '.join(rows)}")
+
+    def act_delete(self):
+        if not self.live_rows:
+            return
+        rid = int(self.rng.choice(list(self.live_rows)))
+        del self.live_rows[rid]
+        self.coord.execute(f"DELETE FROM t WHERE id = {rid}")
+
+    def act_update(self):
+        if not self.live_rows:
+            return
+        rid = int(self.rng.choice(list(self.live_rows)))
+        g, v = self.live_rows[rid]
+        self.live_rows[rid] = (g, v + 7)
+        self.coord.execute(f"UPDATE t SET v = v + 7 WHERE id = {rid}")
+
+    def act_create_mv(self):
+        if self.mv_count >= 3:
+            return
+        name = f"mv{self.mv_count}"
+        self.mv_count += 1
+        self.coord.execute(
+            f"CREATE MATERIALIZED VIEW {name} AS "
+            "SELECT g, sum(v) AS s, count(*) AS n FROM t GROUP BY g"
+        )
+
+    def act_restart(self):
+        self.coord.checkpoint()
+        self.coord = Coordinator(data_dir=self.dir)
+
+    # -- validation ------------------------------------------------------------
+    def validate(self):
+        want = {}
+        for (g, v) in self.live_rows.values():
+            s, n = want.get(g, (0, 0))
+            want[g] = (s + v, n + 1)
+        expected = sorted((g, s, n) for g, (s, n) in want.items())
+        got_table = self.coord.execute(
+            "SELECT g, sum(v), count(*) FROM t GROUP BY g ORDER BY g"
+        ).rows
+        assert got_table == expected, "table recompute diverged"
+        for i in range(self.mv_count):
+            got = self.coord.execute(f"SELECT * FROM mv{i} ORDER BY g").rows
+            assert got == expected, f"mv{i} diverged from recompute"
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_zippy_random_actions(tmp_path, seed):
+    z = Zippy(tmp_path / f"s{seed}", seed)
+    actions = [
+        (z.act_insert, 5),
+        (z.act_delete, 2),
+        (z.act_update, 2),
+        (z.act_create_mv, 1),
+        (z.act_restart, 1),
+    ]
+    fns = [a for a, w in actions for _ in range(w)]
+    z.act_create_mv()  # always at least one MV under maintenance
+    for step in range(30):
+        fn = fns[int(z.rng.integers(0, len(fns)))]
+        fn()
+        if step % 5 == 4:
+            z.validate()
+    z.validate()
