@@ -92,11 +92,28 @@ class ComputeController:
         self.replicas[i] = r
         return r
 
+    def _reduce_history(self, cmd) -> None:
+        """Command-history reduction (protocol/history.rs analogue): keep the
+        history replayable but minimal — only the latest ProcessTo matters,
+        and per-dataflow only the latest AllowCompaction."""
+        if isinstance(cmd, p.ProcessTo):
+            self.history = [c for c in self.history if not isinstance(c, p.ProcessTo)]
+        elif isinstance(cmd, p.AllowCompaction):
+            self.history = [
+                c
+                for c in self.history
+                if not (
+                    isinstance(c, p.AllowCompaction)
+                    and c.dataflow_id == cmd.dataflow_id
+                )
+            ]
+        self.history.append(cmd)
+
     def _broadcast(self, cmd, record: bool = True):
         """Send to every reachable replica; a dead replica is dropped (it will
         be reconciled on reconnect)."""
         if record:
-            self.history.append(cmd)
+            self._reduce_history(cmd)
         out = []
         for i in range(len(self.addrs)):
             r = self._ensure_replica(i)

@@ -63,6 +63,9 @@ def test_cluster_dataflow_ha_and_reconciliation(cluster):
     ctl.process_to(4)
     assert ctl.peek("df1", "idx_bids_sum") == [(10, 350, 2), (11, 100, 2)]
 
+    # command-history reduction keeps replay minimal: one ProcessTo retained
+    assert sum(1 for c in ctl.history if isinstance(c, p.ProcessTo)) == 1
+
     # restart replica 0: controller reconciles by replaying history
     orch.restart_replica("compute", 0)
     # force the controller to re-establish and replay
