@@ -870,7 +870,7 @@ def _expr_dtype(expr, col_dtypes):
             return np.dtype(np.int64)
         if expr.func in ("cast_int32",):
             return np.dtype(np.int32)
-        if expr.func in ("cast_float",):
+        if expr.func in ("cast_float", "sqrt"):
             return np.dtype(np.float32)
         if expr.func in ("not", "is_true"):
             return np.dtype(np.bool_)

@@ -86,6 +86,8 @@ def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             return v.astype(jnp.int32), e
         if expr.func == "cast_float":
             return v.astype(jnp.float32), e
+        if expr.func == "sqrt":
+            return jnp.sqrt(v.astype(jnp.float32)), e
         raise NotImplementedError(f"unary func {expr.func}")
     if isinstance(expr, CallBinary):
         lv, le = eval_expr(expr.left, cols, n)

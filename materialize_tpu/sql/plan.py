@@ -104,7 +104,10 @@ class PlannedQuery:
         return tuple(c.typ.dtype for c in self.scope.cols)
 
 
-_AGG_FUNCS = {"sum", "count", "min", "max", "avg"}
+_AGG_FUNCS = {
+    "sum", "count", "min", "max", "avg",
+    "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+}
 
 
 @dataclass(frozen=True)
@@ -145,6 +148,21 @@ class Planner:
             num = _to_float(Column(e.sum_col), e.vt)
             den = CallUnary("cast_float", Column(e.cnt_col))
             return CallBinary("div", num, den), FLOAT
+        if isinstance(e, _PostStat):
+            # var = (sum_sq - sum^2/n) / (n - ddof); stddev = sqrt(var)
+            s_ = _to_float(Column(e.sum_col), e.vt)
+            sq_t = PType(ColType.NUMERIC, e.vt.scale * 2) if e.vt.col == ColType.NUMERIC else e.vt
+            q = _to_float(Column(e.sq_col), sq_t)
+            n = CallUnary("cast_float", Column(e.cnt_col))
+            mean_sq = CallBinary("div", CallBinary("mul", s_, s_), n)
+            ddof = Literal(0.0 if e.pop else 1.0, "float32")
+            denom = CallBinary("sub", n, ddof)
+            safe = CallVariadic("if", (CallBinary("gt", denom, Literal(0.0, "float32")), denom, Literal(1.0, "float32")))
+            var = CallBinary("div", CallBinary("sub", q, mean_sq), safe)
+            var = CallVariadic("if", (CallBinary("gt", denom, Literal(0.0, "float32")), var, Literal(0.0, "float32")))
+            if e.sqrt:
+                return CallUnary("sqrt", var), FLOAT
+            return var, FLOAT
         if isinstance(e, ast.Ident):
             i = scope.resolve(e.name, e.qualifier)
             return Column(i), scope.cols[i].typ
@@ -428,6 +446,19 @@ class Planner:
             scopes.append(Scope([]))
         for f in sel.from_:
             self._flatten_from(f, factors, scopes, on_preds)
+        # 1b. lift uncorrelated subqueries (IN / EXISTS / scalar) into join
+        # factors — the decorrelation-lite path (reference: HIR→MIR lowering
+        # in src/sql/src/plan/lowering.rs; correlated forms are future work)
+        lifter = _SubqueryLifter(self, factors, scopes)
+        sel = replace(
+            sel,
+            where=lifter.rewrite(sel.where) if sel.where is not None else None,
+            items=tuple(
+                ast.SelectItem(lifter.rewrite(it.expr), it.alias) for it in sel.items
+            ),
+            having=lifter.rewrite(sel.having) if sel.having is not None else None,
+        )
+
         full_scope = Scope([c for s in scopes for c in s.cols])
         offsets = []
         off = 0
@@ -732,6 +763,17 @@ class Planner:
                 cnt_i = len(mir_aggs) - 1
                 post_agg_exprs.append(("avg", (sum_i, cnt_i, vt), FLOAT))
                 agg_types.extend([vt, INT])
+            elif fname in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+                v, vt = self.plan_scalar(a.args[0], scope)
+                mir_aggs.append(mir.MirAggregate("sum", v))
+                sum_i = len(mir_aggs) - 1
+                mir_aggs.append(mir.MirAggregate("sum", CallBinary("mul", v, v)))
+                sq_i = len(mir_aggs) - 1
+                mir_aggs.append(mir.MirAggregate("count", Literal(1)))
+                cnt_i = len(mir_aggs) - 1
+                sq_t = PType(ColType.NUMERIC, vt.scale * 2) if vt.col == ColType.NUMERIC else vt
+                post_agg_exprs.append((fname, (sum_i, sq_i, cnt_i, vt), FLOAT))
+                agg_types.extend([vt, sq_t, INT])
             else:
                 v, vt = self.plan_scalar(a.args[0], scope)
                 out_t = vt if fname != "count" else INT
@@ -782,8 +824,18 @@ class Planner:
             kind, payload, t = self._post_agg_exprs[e.index]
             if kind == "col":
                 return _PostCol(self._post_nkeys + payload)
-            sum_i, cnt_i, vt = payload
-            return _PostAvg(self._post_nkeys + sum_i, self._post_nkeys + cnt_i, vt)
+            if kind == "avg":
+                sum_i, cnt_i, vt = payload
+                return _PostAvg(self._post_nkeys + sum_i, self._post_nkeys + cnt_i, vt)
+            sum_i, sq_i, cnt_i, vt = payload
+            return _PostStat(
+                self._post_nkeys + sum_i,
+                self._post_nkeys + sq_i,
+                self._post_nkeys + cnt_i,
+                vt,
+                pop=kind in ("stddev_pop", "var_pop"),
+                sqrt=kind.startswith("stddev"),
+            )
         if isinstance(e, ast.UnaryOp):
             return replace(e, expr=self._rewrite_post(e.expr))
         if isinstance(e, ast.BinaryOp):
@@ -811,12 +863,102 @@ class _PostAvg:
     vt: PType
 
 
+@dataclass(frozen=True)
+class _PostStat:
+    sum_col: int
+    sq_col: int
+    cnt_col: int
+    vt: PType
+    pop: bool
+    sqrt: bool
+
+
 def _to_float(e, t: PType):
     """Cast to float, descaling NUMERIC fixed-point by its scale factor."""
     f = CallUnary("cast_float", e)
     if t.col == ColType.NUMERIC and t.scale:
         f = CallBinary("div", f, Literal(float(10**t.scale), "float32"))
     return f
+
+
+class _SubqueryLifter:
+    """Rewrite uncorrelated subqueries into extra join factors.
+
+    IN (SELECT …)   → join factor Distinct(sub), predicate expr = hidden col
+    EXISTS (…)      → cross-join factor Distinct(Map(sub → [1])), predicate TRUE
+    scalar (SELECT) → cross-join factor sub (must be single-row), hidden col
+    """
+
+    def __init__(self, planner, factors, scopes):
+        self.planner = planner
+        self.factors = factors
+        self.scopes = scopes
+        self.n = 0
+
+    def _add_factor(self, rel, typ: PType) -> ast.Ident:
+        name = f"__sub{self.n}"
+        self.n += 1
+        self.factors.append(rel)
+        self.scopes.append(Scope([ScopeCol("__sub", name, typ)]))
+        return ast.Ident(name, qualifier="__sub")
+
+    def rewrite(self, e):
+        if e is None or isinstance(
+            e,
+            (ast.NumberLit, ast.StringLit, ast.BoolLit, ast.NullLit, ast.DateLit,
+             ast.Ident, ast.Star),
+        ):
+            return e
+        if isinstance(e, ast.Subquery):
+            pq = self.planner.plan_query(e.query)
+            if e.exists:
+                one = mir.MirProject(
+                    mir.MirMap(pq.mir, (Literal(1),)),
+                    (len(pq.scope.cols),),
+                )
+                ident = self._add_factor(mir.MirDistinct(one), INT)
+                return ast.BoolLit(True)  # presence enforced by the join itself
+            if len(pq.scope.cols) != 1:
+                raise PlanError("scalar subquery must return one column")
+            return self._add_factor(pq.mir, pq.scope.cols[0].typ)
+        if isinstance(e, ast.InList):
+            subs = [i for i in e.items if isinstance(i, ast.Subquery)]
+            if subs:
+                if e.negated:
+                    raise PlanError("NOT IN (SELECT …) not supported yet")
+                if len(e.items) != 1:
+                    raise PlanError("IN mixing subquery and literals unsupported")
+                pq = self.planner.plan_query(subs[0].query)
+                if len(pq.scope.cols) != 1:
+                    raise PlanError("IN subquery must return one column")
+                ident = self._add_factor(
+                    mir.MirDistinct(pq.mir), pq.scope.cols[0].typ
+                )
+                return ast.BinaryOp("=", self.rewrite(e.expr), ident)
+            return replace(e, expr=self.rewrite(e.expr),
+                           items=tuple(self.rewrite(i) for i in e.items))
+        if isinstance(e, ast.UnaryOp):
+            return replace(e, expr=self.rewrite(e.expr))
+        if isinstance(e, ast.BinaryOp):
+            return replace(e, left=self.rewrite(e.left), right=self.rewrite(e.right))
+        if isinstance(e, ast.FuncCall):
+            return replace(e, args=tuple(self.rewrite(a) for a in e.args))
+        if isinstance(e, ast.Cast):
+            return replace(e, expr=self.rewrite(e.expr))
+        if isinstance(e, ast.Between):
+            return replace(
+                e, expr=self.rewrite(e.expr), low=self.rewrite(e.low),
+                high=self.rewrite(e.high),
+            )
+        if isinstance(e, ast.IsNull):
+            return replace(e, expr=self.rewrite(e.expr))
+        if isinstance(e, ast.Case):
+            return ast.Case(
+                self.rewrite(e.operand) if e.operand else None,
+                tuple((self.rewrite(c), self.rewrite(r)) for c, r in e.whens),
+                self.rewrite(e.else_) if e.else_ else None,
+            )
+        return e
 
 
 def _split_and(e):

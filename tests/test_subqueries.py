@@ -1,0 +1,70 @@
+"""Uncorrelated subqueries: IN (SELECT …), EXISTS, scalar subqueries."""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.sql.plan import PlanError
+
+
+@pytest.fixture
+def coord():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int, b int)")
+    c.execute("CREATE TABLE u (x int)")
+    c.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    c.execute("INSERT INTO u VALUES (1), (3), (3)")
+    return c
+
+
+def test_in_subquery_semijoin(coord):
+    r = coord.execute("SELECT a, b FROM t WHERE a IN (SELECT x FROM u) ORDER BY a")
+    # duplicate 3 in u must not duplicate t's row (semijoin, not join)
+    assert r.rows == [(1, 10), (3, 30)]
+
+
+def test_exists(coord):
+    assert coord.execute(
+        "SELECT count(*) FROM t WHERE EXISTS (SELECT x FROM u WHERE x > 2)"
+    ).rows == [(3,)]
+    assert coord.execute(
+        "SELECT count(*) FROM t WHERE EXISTS (SELECT x FROM u WHERE x > 99)"
+    ).rows == []  # empty-group aggregate: no row (documented gap vs SQL)
+
+
+def test_scalar_subquery(coord):
+    r = coord.execute("SELECT a, b - (SELECT min(x) FROM u) FROM t ORDER BY a")
+    assert r.rows == [(1, 9), (2, 19), (3, 29)]
+    r = coord.execute("SELECT a FROM t WHERE b > (SELECT sum(x) FROM u) ORDER BY a")
+    # sum(x) = 7 -> b in {10, 20, 30} all qualify
+    assert r.rows == [(1,), (2,), (3,)]
+
+
+def test_in_subquery_maintained_in_mv(coord):
+    coord.execute(
+        "CREATE MATERIALIZED VIEW m AS SELECT a FROM t WHERE a IN (SELECT x FROM u)"
+    )
+    assert coord.execute("SELECT * FROM m ORDER BY a").rows == [(1,), (3,)]
+    coord.execute("INSERT INTO u VALUES (2)")
+    assert coord.execute("SELECT * FROM m ORDER BY a").rows == [(1,), (2,), (3,)]
+    coord.execute("DELETE FROM u WHERE x = 3")
+    assert coord.execute("SELECT * FROM m ORDER BY a").rows == [(1,), (2,)]
+
+
+def test_not_in_rejected(coord):
+    with pytest.raises(PlanError, match="NOT IN"):
+        coord.execute("SELECT a FROM t WHERE a NOT IN (SELECT x FROM u)")
+
+
+def test_stddev_variance(coord):
+    import math
+
+    coord.execute("CREATE TABLE v (g int, x int)")
+    coord.execute("INSERT INTO v VALUES (1, 2), (1, 4), (1, 6), (2, 5)")
+    r = coord.execute(
+        "SELECT g, var_pop(x), stddev_pop(x), variance(x) FROM v GROUP BY g ORDER BY g"
+    )
+    (g1, vp1, sp1, vs1), (g2, vp2, sp2, vs2) = r.rows
+    assert g1 == 1 and abs(vp1 - 8 / 3) < 1e-3
+    assert abs(sp1 - math.sqrt(8 / 3)) < 1e-3
+    assert abs(vs1 - 4.0) < 1e-3  # sample variance of {2,4,6}
+    assert g2 == 2 and vp2 == 0.0 and vs2 == 0.0  # n=1: samp clamps to 0
