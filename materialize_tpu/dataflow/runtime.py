@@ -866,7 +866,7 @@ def _expr_dtype(expr, col_dtypes):
     if isinstance(expr, s.Literal):
         return np.dtype(expr.dtype)
     if isinstance(expr, s.CallUnary):
-        if expr.func in ("cast_int64",):
+        if expr.func in ("cast_int64", "extract_year", "extract_month", "extract_day"):
             return np.dtype(np.int64)
         if expr.func in ("cast_int32",):
             return np.dtype(np.int32)

@@ -88,6 +88,11 @@ def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             return v.astype(jnp.float32), e
         if expr.func == "sqrt":
             return jnp.sqrt(v.astype(jnp.float32)), e
+        if expr.func in ("extract_year", "extract_month", "extract_day"):
+            y, m, d = _civil_from_days(v)
+            return {"extract_year": y, "extract_month": m, "extract_day": d}[
+                expr.func
+            ], e
         raise NotImplementedError(f"unary func {expr.func}")
     if isinstance(expr, CallBinary):
         lv, le = eval_expr(expr.left, cols, n)
@@ -172,6 +177,26 @@ def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             return out, err
         raise NotImplementedError(f"variadic func {f}")
     raise TypeError(f"not a ScalarExpr: {expr!r}")
+
+
+# days between 1970-01-01 and the engine's date epoch 1992-01-01
+_D1992 = 8035
+
+
+def _civil_from_days(days):
+    """Exact (y, m, d) from day numbers since 1992-01-01 (Hinnant's
+    civil_from_days, pure integer ops — vectorizes on the VPU)."""
+    z = days.astype(jnp.int64) + _D1992 + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
 
 
 def expr_columns(expr: ScalarExpr) -> set[int]:

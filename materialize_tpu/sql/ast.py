@@ -130,6 +130,16 @@ class SubqueryRef:
 
 
 @dataclass(frozen=True)
+class TableFuncRef:
+    """Table function in FROM (generate_series, …) — the reference's
+    TableFunc/FlatMap surface (src/expr/src/relation/func.rs:3563)."""
+
+    name: str
+    args: tuple
+    alias: Optional[str] = None
+
+
+@dataclass(frozen=True)
 class JoinClause:
     left: Any
     right: Any

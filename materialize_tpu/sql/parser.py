@@ -448,6 +448,21 @@ class Parser:
             self.eat_kw("as")
             alias = self.ident()
             return ast.SubqueryRef(q, alias)
+        if self.peek().kind == "IDENT" and self.peek(1).kind == "OP" and self.peek(1).value == "(":
+            fname = self.ident()
+            self.next()
+            args = []
+            if not self.at_op(")"):
+                args.append(self.parse_expr())
+                while self.eat_op(","):
+                    args.append(self.parse_expr())
+            self.expect_op(")")
+            alias = None
+            if self.eat_kw("as"):
+                alias = self.ident()
+            elif self.peek().kind == "IDENT":
+                alias = self.ident()
+            return ast.TableFuncRef(fname, tuple(args), alias)
         name = self.ident()
         alias = None
         if self.eat_kw("as"):
@@ -606,6 +621,14 @@ class Parser:
             return ast.Cast(e, typ)
         if self.at_kw("case"):
             return self.parse_case()
+        if self.peek().kind == "IDENT" and self.peek().value == "extract" and self.peek(1).value == "(":
+            self.next()
+            self.next()
+            fld = self.ident()
+            self.expect_kw("from")
+            e = self.parse_expr()
+            self.expect_op(")")
+            return ast.FuncCall(f"extract_{fld}", (e,))
         if self.at_kw("when"):
             # only reachable from parse_case's operand-less form
             raise ParseError("WHEN outside CASE")

@@ -327,6 +327,12 @@ class Planner:
             planned = [self.plan_scalar(a, scope) for a in e.args]
             t = planned[0][1]
             return CallVariadic(name, tuple(p for p, _ in planned)), t
+        if name in ("extract_year", "extract_month", "extract_day"):
+            v, _t = self.plan_scalar(e.args[0], scope)
+            return CallUnary(name, v), INT
+        if name == "sqrt":
+            v, vt = self.plan_scalar(e.args[0], scope)
+            return CallUnary("sqrt", _to_float(v, vt)), FLOAT
         raise PlanError(f"unsupported function: {name}")
 
     # -- relation planning ---------------------------------------------------
@@ -632,6 +638,30 @@ class Planner:
                 )
             )
             return
+        if isinstance(f, ast.TableFuncRef):
+            if f.name == "generate_series":
+                vals = []
+                for a in f.args:
+                    p, _t = self.plan_scalar(a, Scope([]))
+                    if not isinstance(p, Literal):
+                        raise PlanError("generate_series arguments must be literals")
+                    vals.append(int(p.value))
+                if len(vals) == 2:
+                    lo, hi, step = vals[0], vals[1], 1
+                elif len(vals) == 3:
+                    lo, hi, step = vals
+                else:
+                    raise PlanError("generate_series takes 2 or 3 arguments")
+                if step == 0:
+                    raise PlanError("generate_series step must be nonzero")
+                rows = tuple(((v,), 1) for v in range(lo, hi + (1 if step > 0 else -1), step))
+                factors.append(
+                    mir.MirConstant(rows=rows, dtypes=(np.dtype(np.int64),))
+                )
+                alias = f.alias or "generate_series"
+                scopes.append(Scope([ScopeCol(alias, alias, INT)]))
+                return
+            raise PlanError(f"unsupported table function {f.name}")
         if isinstance(f, ast.SubqueryRef):
             pq = self.plan_query(f.query)
             rel = pq.mir
