@@ -675,6 +675,11 @@ class Coordinator:
         """Group commit: append to storage (and persist shards), then flow
         through every installed dataflow in dependency order (an MV's output
         delta becomes visible to downstream MVs at the same timestamp)."""
+        from ..utils.memory_limiter import MemoryLimiter
+
+        limit = int(self.configs.get("memory_limit_mb"))
+        if limit:
+            MemoryLimiter(limit).check()
         env = dict(writes)
         for gid, batch in writes.items():
             self.storage[gid].append(batch, ts)

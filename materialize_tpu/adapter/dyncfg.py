@@ -82,6 +82,10 @@ INDEX_FAST_PATH = Config(
 INTROSPECTION = Config(
     "enable_introspection", True, "expose mz_* introspection relations"
 )
+MEMORY_LIMIT_MB = Config(
+    "memory_limit_mb", 0, "refuse writes when process RSS exceeds this "
+    "(0 = off; the memory_limiter.rs watchdog analogue)"
+)
 LOG_FILTER = Config(
     "log_filter", "off", "tracing emission level: off | info | debug "
     "(the ALTER SYSTEM SET log_filter analogue, doc/developer/tracing.md)"
@@ -94,6 +98,7 @@ ALL_CONFIGS = [
     INDEX_FAST_PATH,
     INTROSPECTION,
     LOG_FILTER,
+    MEMORY_LIMIT_MB,
 ]
 
 

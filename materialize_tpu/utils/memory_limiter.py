@@ -1,0 +1,49 @@
+"""Process memory watchdog.
+
+The analogue of the reference's memory limiter
+(src/compute/src/memory_limiter.rs:9-12: a process memory+swap watchdog that
+intervenes before the OOM killer does). Reads RSS from /proc/self/statm
+(no psutil dependency); the coordinator checks it on every commit and refuses
+further writes past the hard limit — failing the statement beats losing the
+process.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def rss_mb() -> float:
+    try:
+        with open("/proc/self/statm") as f:
+            parts = f.read().split()
+        return int(parts[1]) * _PAGE / (1024 * 1024)
+    except (OSError, IndexError, ValueError):
+        return 0.0
+
+
+class MemoryLimiter:
+    def __init__(self, limit_mb: int = 0, soft_frac: float = 0.9):
+        self.limit_mb = limit_mb
+        self.soft_frac = soft_frac
+        self._warned = False
+
+    def check(self) -> None:
+        """Raise past the hard limit; warn once past the soft limit."""
+        if self.limit_mb <= 0:
+            return
+        rss = rss_mb()
+        if rss > self.limit_mb:
+            raise MemoryError(
+                f"memory limiter: RSS {rss:.0f} MiB exceeds limit {self.limit_mb} MiB"
+            )
+        if rss > self.limit_mb * self.soft_frac and not self._warned:
+            self._warned = True
+            print(
+                f"[memory-limiter] RSS {rss:.0f} MiB above "
+                f"{self.soft_frac:.0%} of the {self.limit_mb} MiB limit",
+                file=sys.stderr,
+            )

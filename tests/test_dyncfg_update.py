@@ -72,3 +72,16 @@ def test_counter_source():
         c.advance()
     rows = c.execute("SELECT counter FROM counter ORDER BY counter").rows
     assert rows == [(3,), (4,), (5,)]  # only the last 3 retained
+
+
+def test_memory_limiter():
+    import pytest as _pytest
+
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int)")
+    c.execute("ALTER SYSTEM SET memory_limit_mb = 1")  # absurdly low: trips
+    with _pytest.raises(MemoryError, match="memory limiter"):
+        c.execute("INSERT INTO t VALUES (1)")
+    c.execute("ALTER SYSTEM SET memory_limit_mb = 0")  # off again
+    c.execute("INSERT INTO t VALUES (1)")
+    assert c.execute("SELECT count(*) FROM t").rows == [(1,)]
