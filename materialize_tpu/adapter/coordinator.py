@@ -71,7 +71,10 @@ class Coordinator:
     model (SURVEY.md §5 checkpoint/resume: durable state is only shards +
     the durable catalog; everything else re-renders)."""
 
-    def __init__(self, data_dir: str | None = None, blob=None, consensus=None) -> None:
+    def __init__(
+        self, data_dir: str | None = None, blob=None, consensus=None,
+        preflight: bool = False,
+    ) -> None:
         self.catalog = Catalog()
         self.oracle = TimestampOracle()
         self.storage: dict[str, StorageCollection] = {}
@@ -90,9 +93,20 @@ class Coordinator:
             self.blob = FileBlob(f"{data_dir}/blob")
             self.consensus = FileConsensus(f"{data_dir}/consensus")
         self.shards: dict[str, object] = {}  # gid -> ShardMachine
+        # 0dt deployment state machine (deployment/state.rs:19-24 analogue):
+        # init → catching-up (preflight, read-only) → leader; stale leaders
+        # become "fenced" when a newer generation takes over.
+        self.deploy_state = "init"
+        self.epoch = 0
         self._register_introspection()
         if self.durable:
             self._boot()
+            if preflight:
+                self.deploy_state = "catching-up"
+            else:
+                self._take_leadership()
+        else:
+            self.deploy_state = "leader"
 
     def _register_introspection(self) -> None:
         from .introspection import INTROSPECTION_TABLES, IntrospectionCollection
@@ -670,11 +684,75 @@ class Coordinator:
             i.global_id for i in self.catalog.items.values() if i.append_only
         }
 
+    # -- 0dt deployment --------------------------------------------------------
+    def _take_leadership(self) -> None:
+        """Become the writing generation: bump the leader epoch and fence
+        every shard so the previous generation's next write raises Fenced."""
+        import json as _json
+
+        for _ in range(8):
+            head = self.consensus.head("leader")
+            cur = _json.loads(head.data)["epoch"] if head is not None else 0
+            self.epoch = cur + 1
+            doc = _json.dumps({"epoch": self.epoch}).encode()
+            if self.consensus.compare_and_set(
+                "leader", head.seqno if head is not None else None, doc
+            ):
+                break
+        else:
+            raise RuntimeError("leader CAS contention")
+        for item in self.catalog.items.values():
+            if item.kind in ("table", "source", "materialized_view"):
+                self._shard(item.global_id).fence(self.epoch)
+        self.deploy_state = "leader"
+
+    def catch_up(self) -> int:
+        """Preflight: pull new shard data into local state (read-only).
+        Returns the number of commits applied."""
+        from ..persist import ShardMachine
+
+        per_time: dict[int, dict[str, UpdateBatch]] = {}
+        for item in list(self.catalog.items.values()):
+            if item.kind not in ("table", "source"):
+                continue
+            gid = item.global_id
+            store = self.storage[gid]
+            m = self._shard(gid)
+            batches, upper = m.listen_from(store.upper)
+            import numpy as _np
+
+            for cols in batches:
+                for t in _np.unique(cols["times"]):
+                    mask = cols["times"] == t
+                    data = [
+                        cols[f"c{i}"][mask] for i in range(len(store.dtypes))
+                    ]
+                    b = UpdateBatch.build(
+                        (), tuple(data), cols["times"][mask], cols["diffs"][mask]
+                    )
+                    per_time.setdefault(int(t), {})[gid] = b
+        for t in sorted(per_time):
+            self.oracle.apply_write(t)
+            self._apply_writes(per_time[t], t, persist=False)
+        return len(per_time)
+
+    def promote(self) -> None:
+        """Finish a 0dt handoff: final catch-up, then take leadership
+        (ReadyToPromote → IsLeader)."""
+        self.catch_up()
+        self._take_leadership()
+
     # -- write propagation -----------------------------------------------------
-    def _apply_writes(self, writes: dict[str, UpdateBatch], ts: int) -> None:
+    def _apply_writes(
+        self, writes: dict[str, UpdateBatch], ts: int, persist: bool = True
+    ) -> None:
         """Group commit: append to storage (and persist shards), then flow
         through every installed dataflow in dependency order (an MV's output
         delta becomes visible to downstream MVs at the same timestamp)."""
+        if persist and self.durable and self.deploy_state != "leader":
+            raise PlanError(
+                f"read-only: this instance is {self.deploy_state}, not the leader"
+            )
         from ..utils.memory_limiter import MemoryLimiter
 
         limit = int(self.configs.get("memory_limit_mb"))
@@ -694,17 +772,21 @@ class Coordinator:
             if out is not None and out[0] is not None:
                 env[mv_gid] = out[0]
                 self.storage[mv_gid].append(out[0], ts)
-        if self.durable:
-            from ..persist import UpperMismatch
+        if persist and self.durable:
+            from ..persist import Fenced
 
-            for gid, batch in env.items():
-                m = self._shard(gid)
-                h = batch.to_host()
-                cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
-                cols["times"] = h["times"]
-                cols["diffs"] = h["diffs"]
-                lower = m.upper()
-                m.compare_and_append(cols, lower, ts + 1)
+            try:
+                for gid, batch in env.items():
+                    m = self._shard(gid)
+                    h = batch.to_host()
+                    cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
+                    cols["times"] = h["times"]
+                    cols["diffs"] = h["diffs"]
+                    lower = m.upper()
+                    m.compare_and_append(cols, lower, ts + 1, epoch=self.epoch)
+            except Fenced:
+                self.deploy_state = "fenced"
+                raise
             if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
                 self._persist_catalog()
 

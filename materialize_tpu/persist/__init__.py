@@ -8,7 +8,7 @@ from .location import (
     UnreliableBlob,
     UnreliableConsensus,
 )
-from .shard import ShardMachine, ShardState, UpperMismatch
+from .shard import Fenced, ShardMachine, ShardState, UpperMismatch
 
 __all__ = [
     "Blob",
@@ -19,6 +19,7 @@ __all__ = [
     "MemConsensus",
     "UnreliableBlob",
     "UnreliableConsensus",
+    "Fenced",
     "ShardMachine",
     "ShardState",
     "UpperMismatch",

@@ -32,6 +32,20 @@ class UpperMismatch(Exception):
         self.actual = actual
 
 
+class Fenced(Exception):
+    """A newer-epoch writer owns this shard; this writer is a zombie.
+
+    The persist fencing primitive (reference: persist fences zombie writers
+    through consensus CAS, SURVEY.md §5 failure detection) that makes 0dt
+    handoffs safe: the old generation's next write fails here.
+    """
+
+    def __init__(self, writer_epoch: int, shard_epoch: int):
+        super().__init__(
+            f"fenced: writer epoch {writer_epoch} < shard epoch {shard_epoch}"
+        )
+
+
 @dataclass
 class HollowBatch:
     """Manifest entry: payload key + [lower, upper) + row count."""
@@ -47,6 +61,7 @@ class ShardState:
     since: int = 0
     upper: int = 0
     batches: list = field(default_factory=list)  # list[HollowBatch]
+    epoch: int = 0  # writer generation; lower-epoch writers are fenced
 
     def encode(self) -> bytes:
         return json.dumps(
@@ -56,6 +71,7 @@ class ShardState:
                 "batches": [
                     [b.key, b.lower, b.upper, b.count] for b in self.batches
                 ],
+                "epoch": self.epoch,
             }
         ).encode()
 
@@ -66,6 +82,7 @@ class ShardState:
             since=doc["since"],
             upper=doc["upper"],
             batches=[HollowBatch(*b) for b in doc["batches"]],
+            epoch=doc.get("epoch", 0),
         )
 
 
@@ -102,14 +119,37 @@ class ShardMachine:
         return self.fetch_state()[1].since
 
     # -- writes ---------------------------------------------------------------
+    def fence(self, epoch: int, max_retries: int = 8) -> None:
+        """Become the shard's writer generation; older epochs get Fenced."""
+        for _ in range(max_retries):
+            seqno, state = self.fetch_state()
+            if state.epoch > epoch:
+                raise Fenced(epoch, state.epoch)
+            new = ShardState(state.since, state.upper, state.batches, epoch)
+            if self.consensus.compare_and_set(self._key, seqno, new.encode()):
+                return
+        raise RuntimeError("fence: CAS contention")
+
     def compare_and_append(
-        self, cols: dict, lower: int, upper: int, max_retries: int = 8
+        self,
+        cols: dict,
+        lower: int,
+        upper: int,
+        max_retries: int = 8,
+        epoch: Optional[int] = None,
     ) -> None:
         """Append columns covering [lower, upper); CAS the manifest.
 
         cols: {'times': u64[n], 'diffs': i64[n], 'c0': …} host arrays; may be
-        empty (a pure upper advancement).
+        empty (a pure upper advancement). With `epoch`, the write only
+        succeeds while this writer generation still owns the shard.
         """
+        if epoch is not None:
+            # fencing outranks argument validation: a zombie writer must learn
+            # it lost leadership, not get a confusing bounds error
+            _seq0, state0 = self.fetch_state()
+            if state0.epoch > epoch:
+                raise Fenced(epoch, state0.epoch)
         if upper <= lower:
             raise ValueError(f"upper {upper} must exceed lower {lower}")
         n = int(len(cols["times"])) if "times" in cols else 0
@@ -119,6 +159,8 @@ class ShardMachine:
             self.blob.set(payload_key, encode_columns(cols))
         for _ in range(max_retries):
             seqno, state = self.fetch_state()
+            if epoch is not None and state.epoch > epoch:
+                raise Fenced(epoch, state.epoch)
             if state.upper != lower:
                 raise UpperMismatch(lower, state.upper)
             new = ShardState(
@@ -126,6 +168,7 @@ class ShardMachine:
                 upper=upper,
                 batches=list(state.batches)
                 + ([HollowBatch(payload_key, lower, upper, n)] if n else []),
+                epoch=state.epoch,
             )
             if self.consensus.compare_and_set(self._key, seqno, new.encode()):
                 return
@@ -174,7 +217,8 @@ class ShardMachine:
         for _ in range(max_retries):
             seqno, state = self.fetch_state()
             new = ShardState(
-                since=max(state.since, since), upper=state.upper, batches=state.batches
+                since=max(state.since, since), upper=state.upper,
+                batches=state.batches, epoch=state.epoch,
             )
             if self.consensus.compare_and_set(self._key, seqno, new.encode()):
                 return
@@ -208,6 +252,7 @@ class ShardMachine:
             since=state.since,
             upper=state.upper,
             batches=keep + ([HollowBatch(new_key, lower, upper, n)] if n else []),
+            epoch=state.epoch,
         )
         for _ in range(max_retries):
             if self.consensus.compare_and_set(self._key, seqno, new_state.encode()):

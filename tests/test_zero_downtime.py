@@ -1,0 +1,64 @@
+"""0dt deployment: preflight catch-up, promotion, zombie-writer fencing.
+
+The reference's zero-downtime upgrade state machine
+(src/environmentd/src/deployment/state.rs:19-93: Initializing → CatchingUp →
+ReadyToPromote → IsLeader) plus persist's consensus-CAS writer fencing:
+the new generation hydrates while the old serves, promotes, and the old
+generation's next write raises Fenced.
+"""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.persist import Fenced
+from materialize_tpu.sql.plan import PlanError
+
+
+def test_preflight_catchup_promote_fence(tmp_path):
+    d = str(tmp_path / "env")
+    old = Coordinator(data_dir=d)
+    old.execute("CREATE TABLE t (a int)")
+    old.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT count(*) AS n FROM t"
+    )
+    old.execute("INSERT INTO t VALUES (1), (2)")
+    assert old.deploy_state == "leader"
+
+    # new generation boots in preflight: sees the data but cannot write
+    new = Coordinator(data_dir=d, preflight=True)
+    assert new.deploy_state == "catching-up"
+    assert new.execute("SELECT * FROM mv").rows == [(2,)]
+    with pytest.raises(PlanError, match="read-only"):
+        new.execute("INSERT INTO t VALUES (99)")
+
+    # old generation keeps serving writes during the catch-up window
+    old.execute("INSERT INTO t VALUES (3)")
+    assert new.catch_up() >= 1
+    assert new.execute("SELECT * FROM mv").rows == [(3,)]
+
+    # promote: new becomes leader; old is a zombie and gets fenced
+    new.promote()
+    assert new.deploy_state == "leader"
+    new.execute("INSERT INTO t VALUES (4)")
+    assert new.execute("SELECT * FROM mv").rows == [(4,)]
+    with pytest.raises(Fenced):
+        old.execute("INSERT INTO t VALUES (1000)")
+    assert old.deploy_state == "fenced"
+
+    # the fenced write must not have landed
+    assert new.execute("SELECT count(*) FROM t").rows == [(4,)]
+
+
+def test_restart_after_promotion_keeps_latest(tmp_path):
+    d = str(tmp_path / "env")
+    c1 = Coordinator(data_dir=d)
+    c1.execute("CREATE TABLE t (a int)")
+    c1.execute("INSERT INTO t VALUES (1)")
+    c2 = Coordinator(data_dir=d, preflight=True)
+    c2.promote()
+    c2.execute("INSERT INTO t VALUES (2)")
+    # a fresh boot (generation 3) sees everything and can write
+    c3 = Coordinator(data_dir=d)
+    assert c3.execute("SELECT a FROM t ORDER BY a").rows == [(1,), (2,)]
+    c3.execute("INSERT INTO t VALUES (3)")
+    assert c3.execute("SELECT count(*) FROM t").rows == [(3,)]
