@@ -44,6 +44,9 @@ class PgConnection:
         self.sock = sock
         self.coord = coordinator
         self.lock = lock
+        # extended query protocol state (protocol.rs StateMachine analogue)
+        self.statements: dict[str, str] = {}  # name -> sql with $n params
+        self.portals: dict[str, str] = {}  # name -> bound sql
 
     # -- startup ---------------------------------------------------------------
     def run(self) -> None:
@@ -58,9 +61,20 @@ class PgConnection:
                 if tag == b"Q":
                     sql = payload[:-1].decode()
                     self._simple_query(sql)
-                elif tag in (b"P", b"B", b"E", b"D", b"S", b"C"):
-                    self._send_error("0A000", "extended query protocol not supported yet")
+                elif tag == b"P":
+                    self._handle_parse(payload)
+                elif tag == b"B":
+                    self._handle_bind(payload)
+                elif tag == b"D":
+                    self._handle_describe(payload)
+                elif tag == b"E":
+                    self._handle_execute(payload)
+                elif tag == b"C":
+                    self._handle_close(payload)
+                elif tag == b"S":  # Sync
                     self._send_ready()
+                elif tag == b"H":  # Flush
+                    pass
                 else:
                     self._send_error("08P01", f"unexpected message {tag!r}")
                     self._send_ready()
@@ -155,6 +169,105 @@ class PgConnection:
             else:
                 self.sock.sendall(_msg(b"C", _cstr(r.status)))
         self._send_ready()
+
+    # -- extended query protocol ------------------------------------------------
+    @staticmethod
+    def _read_cstr(payload: bytes, off: int) -> tuple[str, int]:
+        end = payload.index(b"\x00", off)
+        return payload[off:end].decode(), end + 1
+
+    def _handle_parse(self, payload: bytes) -> None:
+        name, off = self._read_cstr(payload, 0)
+        sql, off = self._read_cstr(payload, off)
+        # declared parameter type OIDs are accepted and ignored (text mode)
+        self.statements[name] = sql
+        self.sock.sendall(_msg(b"1", b""))  # ParseComplete
+
+    def _handle_bind(self, payload: bytes) -> None:
+        portal, off = self._read_cstr(payload, 0)
+        stmt, off = self._read_cstr(payload, off)
+        (n_fmt,) = struct.unpack(">H", payload[off : off + 2])
+        off += 2
+        fmts = []
+        for _ in range(n_fmt):
+            (f,) = struct.unpack(">H", payload[off : off + 2])
+            fmts.append(f)
+            off += 2
+        (n_params,) = struct.unpack(">H", payload[off : off + 2])
+        off += 2
+        params: list[str | None] = []
+        for i in range(n_params):
+            (ln,) = struct.unpack(">i", payload[off : off + 4])
+            off += 4
+            if ln < 0:
+                params.append(None)
+            else:
+                fmt = fmts[i] if i < len(fmts) else (fmts[0] if len(fmts) == 1 else 0)
+                if fmt != 0:
+                    self._send_error("0A000", "binary parameters not supported")
+                    return
+                params.append(payload[off : off + ln].decode())
+                off += ln
+        sql = self.statements.get(stmt)
+        if sql is None:
+            self._send_error("26000", f"unknown prepared statement {stmt!r}")
+            return
+        # substitute $n textually (params are re-literalized; the planner has
+        # no placeholder support yet — extended-protocol compat shim)
+        import re as _re
+
+        def sub(m):
+            i = int(m.group(1)) - 1
+            if i >= len(params):
+                return m.group(0)
+            v = params[i]
+            if v is None:
+                return "NULL"
+            if _re.fullmatch(r"-?\d+(\.\d+)?", v):
+                return v
+            return "'" + v.replace("'", "''") + "'"
+
+        self.portals[portal] = _re.sub(r"\$(\d+)", sub, sql)
+        self.sock.sendall(_msg(b"2", b""))  # BindComplete
+
+    def _handle_describe(self, payload: bytes) -> None:
+        kind = payload[0:1]
+        _name, _ = self._read_cstr(payload, 1)
+        # NoData: row descriptions are sent with Execute results instead;
+        # clients tolerate this for text-mode flows
+        if kind == b"S":
+            self.sock.sendall(_msg(b"t", struct.pack(">H", 0)))  # ParameterDescription
+        self.sock.sendall(_msg(b"n", b""))  # NoData
+
+    def _handle_execute(self, payload: bytes) -> None:
+        portal, off = self._read_cstr(payload, 0)
+        sql = self.portals.get(portal)
+        if sql is None:
+            self._send_error("34000", f"unknown portal {portal!r}")
+            return
+        try:
+            with self.lock:
+                results = self.coord.execute_script(sql)
+        except Exception as e:
+            self._send_error("XX000", str(e))
+            return
+        for r in results:
+            if r.kind == "rows":
+                self._send_row_description(r)
+                for row in r.rows:
+                    self._send_data_row(row)
+                self.sock.sendall(_msg(b"C", _cstr(f"SELECT {len(r.rows)}")))
+            else:
+                self.sock.sendall(_msg(b"C", _cstr(r.status)))
+
+    def _handle_close(self, payload: bytes) -> None:
+        kind = payload[0:1]
+        name, _ = self._read_cstr(payload, 1)
+        if kind == b"S":
+            self.statements.pop(name, None)
+        else:
+            self.portals.pop(name, None)
+        self.sock.sendall(_msg(b"3", b""))  # CloseComplete
 
     def _send_row_description(self, r: ExecResult) -> None:
         payload = struct.pack(">H", len(r.columns))

@@ -132,3 +132,44 @@ def test_pgwire_mv_roundtrip(pg):
     pg.query("INSERT INTO bids VALUES (1, 10), (1, 5)")
     rows, cols, tags, _ = pg.query("SELECT * FROM totals")
     assert rows == [("1", "15")]
+
+
+def test_extended_query_protocol(pg):
+    """Parse/Bind/Execute/Sync with text parameters (psycopg3/JDBC shape)."""
+    import struct as st
+
+    pg.query("CREATE TABLE p (a int, b text)")
+
+    def send(tag, payload):
+        pg.sock.sendall(tag + st.pack(">I", len(payload) + 4) + payload)
+
+    def cstr(s):
+        return s.encode() + b"\x00"
+
+    # Parse unnamed statement with two params
+    send(b"P", cstr("") + cstr("INSERT INTO p VALUES ($1, $2)") + st.pack(">H", 0))
+    # Bind with text params 42, 'hi'
+    params = st.pack(">H", 0) + st.pack(">H", 2)
+    for v in (b"42", b"hi"):
+        params += st.pack(">i", len(v)) + v
+    send(b"B", cstr("") + cstr("") + params + st.pack(">H", 0))
+    send(b"E", cstr("") + st.pack(">i", 0))
+    send(b"S", b"")
+    msgs = pg.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert b"1" in tags and b"2" in tags and b"C" in tags
+
+    rows, cols, ctags, errors = pg.query("SELECT a, b FROM p")
+    assert rows == [("42", "hi")] and not errors
+
+    # quoting: a parameter with an embedded quote must not break out
+    send(b"P", cstr("s1") + cstr("INSERT INTO p VALUES ($1, $2)") + st.pack(">H", 0))
+    params = st.pack(">H", 0) + st.pack(">H", 2)
+    for v in (b"7", b"o'brien"):
+        params += st.pack(">i", len(v)) + v
+    send(b"B", cstr("") + cstr("s1") + params + st.pack(">H", 0))
+    send(b"E", cstr("") + st.pack(">i", 0))
+    send(b"S", b"")
+    pg.read_until(b"Z")
+    rows, _c, _t, _e = pg.query("SELECT b FROM p WHERE a = 7")
+    assert rows == [("o'brien",)]
