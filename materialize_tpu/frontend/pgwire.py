@@ -39,6 +39,53 @@ def _cstr(s: str) -> bytes:
     return s.encode() + b"\x00"
 
 
+def _scan_params(sql: str) -> list[tuple[int, int, int]]:
+    """Positions of $n placeholders OUTSIDE single-quoted strings.
+
+    Returns [(start, end, param_index)] in order of appearance.
+    """
+    out = []
+    i, n = 0, len(sql)
+    in_str = False
+    while i < n:
+        c = sql[i]
+        if in_str:
+            if c == "'":
+                if i + 1 < n and sql[i + 1] == "'":
+                    i += 2
+                    continue
+                in_str = False
+            i += 1
+            continue
+        if c == "'":
+            in_str = True
+            i += 1
+            continue
+        if c == "$" and i + 1 < n and sql[i + 1].isdigit():
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            out.append((i, j, int(sql[i + 1 : j])))
+            i = j
+            continue
+        i += 1
+    return out
+
+
+def _literalize(v: str | None) -> str:
+    if v is None:
+        return "NULL"
+    import re as _re
+
+    # bare numeric only when the text round-trips exactly (no leading zeros,
+    # no '+', …): '007' or '1.50' must stay strings or they'd be corrupted
+    if _re.fullmatch(r"-?(0|[1-9]\d*)", v):
+        return v
+    if _re.fullmatch(r"-?(0|[1-9]\d*)\.\d*[1-9]", v) or v in ("0.0",):
+        return v
+    return "'" + v.replace("'", "''") + "'"
+
+
 class PgConnection:
     def __init__(self, sock: socket.socket, coordinator: Coordinator, lock):
         self.sock = sock
@@ -47,6 +94,8 @@ class PgConnection:
         # extended query protocol state (protocol.rs StateMachine analogue)
         self.statements: dict[str, str] = {}  # name -> sql with $n params
         self.portals: dict[str, str] = {}  # name -> bound sql
+        # after an error, skip messages until Sync (spec-mandated)
+        self.in_error = False
 
     # -- startup ---------------------------------------------------------------
     def run(self) -> None:
@@ -61,20 +110,28 @@ class PgConnection:
                 if tag == b"Q":
                     sql = payload[:-1].decode()
                     self._simple_query(sql)
-                elif tag == b"P":
-                    self._handle_parse(payload)
-                elif tag == b"B":
-                    self._handle_bind(payload)
-                elif tag == b"D":
-                    self._handle_describe(payload)
-                elif tag == b"E":
-                    self._handle_execute(payload)
-                elif tag == b"C":
-                    self._handle_close(payload)
-                elif tag == b"S":  # Sync
+                elif tag == b"S":  # Sync: clear error state, drop portals
+                    self.in_error = False
+                    self.portals.clear()
                     self._send_ready()
                 elif tag == b"H":  # Flush
                     pass
+                elif tag in (b"P", b"B", b"D", b"E", b"C"):
+                    if self.in_error:
+                        continue  # discard until Sync, per spec
+                    try:
+                        handler = {
+                            b"P": self._handle_parse,
+                            b"B": self._handle_bind,
+                            b"D": self._handle_describe,
+                            b"E": self._handle_execute,
+                            b"C": self._handle_close,
+                        }[tag]
+                        handler(payload)
+                    except (ConnectionError, OSError):
+                        raise
+                    except Exception as e:  # malformed payloads etc.
+                        self._ext_error("08P01", f"protocol error: {e}")
                 else:
                     self._send_error("08P01", f"unexpected message {tag!r}")
                     self._send_ready()
@@ -159,18 +216,26 @@ class PgConnection:
             self._send_error("XX000", str(e))
             self._send_ready()
             return
-        for r in results:
-            if r.kind == "rows":
-                self._send_row_description(r)
-                for row in r.rows:
-                    self._send_data_row(row)
-                tag = f"SELECT {len(r.rows)}"
-                self.sock.sendall(_msg(b"C", _cstr(tag)))
-            else:
-                self.sock.sendall(_msg(b"C", _cstr(r.status)))
+        self._send_results(results, with_description=True)
         self._send_ready()
 
+    def _send_results(self, results, with_description: bool) -> None:
+        for r in results:
+            if r.kind == "rows":
+                if with_description:
+                    self._send_row_description(r)
+                for row in r.rows:
+                    self._send_data_row(row)
+                self.sock.sendall(_msg(b"C", _cstr(f"SELECT {len(r.rows)}")))
+            else:
+                self.sock.sendall(_msg(b"C", _cstr(r.status)))
+
     # -- extended query protocol ------------------------------------------------
+    def _ext_error(self, code: str, message: str) -> None:
+        """Error inside the extended flow: report and ignore until Sync."""
+        self._send_error(code, message)
+        self.in_error = True
+
     @staticmethod
     def _read_cstr(payload: bytes, off: int) -> tuple[str, int]:
         end = payload.index(b"\x00", off)
@@ -180,6 +245,12 @@ class PgConnection:
         name, off = self._read_cstr(payload, 0)
         sql, off = self._read_cstr(payload, off)
         # declared parameter type OIDs are accepted and ignored (text mode)
+        if name and name in self.statements:
+            self._ext_error("42P05", f"prepared statement {name!r} already exists")
+            return
+        if ";" in sql.strip().rstrip(";"):
+            self._ext_error("42601", "multiple statements not allowed in Parse")
+            return
         self.statements[name] = sql
         self.sock.sendall(_msg(b"1", b""))  # ParseComplete
 
@@ -204,61 +275,102 @@ class PgConnection:
             else:
                 fmt = fmts[i] if i < len(fmts) else (fmts[0] if len(fmts) == 1 else 0)
                 if fmt != 0:
-                    self._send_error("0A000", "binary parameters not supported")
+                    self._ext_error("0A000", "binary parameters not supported")
                     return
                 params.append(payload[off : off + ln].decode())
                 off += ln
         sql = self.statements.get(stmt)
         if sql is None:
-            self._send_error("26000", f"unknown prepared statement {stmt!r}")
+            self._ext_error("26000", f"unknown prepared statement {stmt!r}")
             return
-        # substitute $n textually (params are re-literalized; the planner has
-        # no placeholder support yet — extended-protocol compat shim)
-        import re as _re
-
-        def sub(m):
-            i = int(m.group(1)) - 1
-            if i >= len(params):
-                return m.group(0)
-            v = params[i]
-            if v is None:
-                return "NULL"
-            if _re.fullmatch(r"-?\d+(\.\d+)?", v):
-                return v
-            return "'" + v.replace("'", "''") + "'"
-
-        self.portals[portal] = _re.sub(r"\$(\d+)", sub, sql)
+        # substitute $n textually, skipping string literals (planner
+        # placeholder support is future work — extended-protocol compat shim)
+        spots = _scan_params(sql)
+        out = []
+        last = 0
+        for start, end, idx in spots:
+            out.append(sql[last:start])
+            if idx - 1 < len(params):
+                out.append(_literalize(params[idx - 1]))
+            else:
+                self._ext_error("08P01", f"parameter ${idx} not bound")
+                return
+            last = end
+        out.append(sql[last:])
+        self.portals[portal] = "".join(out)
         self.sock.sendall(_msg(b"2", b""))  # BindComplete
+
+    def _describe_columns(self, sql: str):
+        """Column (name, oid) pairs for a statement, or None for no result set."""
+        from ..repr.types import ColType
+        from ..sql import ast as _ast
+        from ..sql.parser import parse_statement
+
+        stmt = parse_statement(sql)
+        if not isinstance(stmt, _ast.SelectStatement):
+            return None
+        with self.lock:
+            pq = self.coord.planner.plan_query(stmt.query)
+        oid_of = {
+            ColType.INT64: _OID_INT8,
+            ColType.INT32: _OID_INT8,
+            ColType.BOOL: _OID_BOOL,
+            ColType.FLOAT64: _OID_FLOAT8,
+            ColType.NUMERIC: _OID_NUMERIC,
+        }
+        return [
+            (c.name or f"column{i+1}", oid_of.get(c.typ.col, _OID_TEXT))
+            for i, c in enumerate(pq.scope.cols)
+        ]
+
+    def _send_description(self, cols) -> None:
+        payload = struct.pack(">H", len(cols))
+        for name, oid in cols:
+            payload += _cstr(name) + struct.pack(">IhIhih", 0, 0, oid, -1, -1, 0)
+        self.sock.sendall(_msg(b"T", payload))
 
     def _handle_describe(self, payload: bytes) -> None:
         kind = payload[0:1]
-        _name, _ = self._read_cstr(payload, 1)
-        # NoData: row descriptions are sent with Execute results instead;
-        # clients tolerate this for text-mode flows
+        name, _ = self._read_cstr(payload, 1)
         if kind == b"S":
-            self.sock.sendall(_msg(b"t", struct.pack(">H", 0)))  # ParameterDescription
-        self.sock.sendall(_msg(b"n", b""))  # NoData
+            sql = self.statements.get(name)
+            if sql is None:
+                self._ext_error("26000", f"unknown prepared statement {name!r}")
+                return
+            n_params = len({idx for _s, _e, idx in _scan_params(sql)})
+            self.sock.sendall(
+                _msg(b"t", struct.pack(">H", n_params) + struct.pack(">I", _OID_TEXT) * n_params)
+            )
+        else:
+            sql = self.portals.get(name)
+            if sql is None:
+                self._ext_error("34000", f"unknown portal {name!r}")
+                return
+        # best-effort planning: statements may still contain unbound $n
+        try:
+            cols = self._describe_columns(sql)
+        except Exception:
+            cols = None
+        if cols:
+            self._send_description(cols)
+        else:
+            self.sock.sendall(_msg(b"n", b""))  # NoData
 
     def _handle_execute(self, payload: bytes) -> None:
         portal, off = self._read_cstr(payload, 0)
         sql = self.portals.get(portal)
         if sql is None:
-            self._send_error("34000", f"unknown portal {portal!r}")
+            self._ext_error("34000", f"unknown portal {portal!r}")
             return
         try:
             with self.lock:
                 results = self.coord.execute_script(sql)
         except Exception as e:
-            self._send_error("XX000", str(e))
+            self._ext_error("XX000", str(e))
             return
-        for r in results:
-            if r.kind == "rows":
-                self._send_row_description(r)
-                for row in r.rows:
-                    self._send_data_row(row)
-                self.sock.sendall(_msg(b"C", _cstr(f"SELECT {len(r.rows)}")))
-            else:
-                self.sock.sendall(_msg(b"C", _cstr(r.status)))
+        # per protocol, Execute emits DataRows only (RowDescription belongs
+        # to Describe)
+        self._send_results(results, with_description=False)
 
     def _handle_close(self, payload: bytes) -> None:
         kind = payload[0:1]

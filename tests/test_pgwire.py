@@ -173,3 +173,61 @@ def test_extended_query_protocol(pg):
     pg.read_until(b"Z")
     rows, _c, _t, _e = pg.query("SELECT b FROM p WHERE a = 7")
     assert rows == [("o'brien",)]
+
+
+def test_extended_protocol_details(pg):
+    """Describe row descriptions, param-count report, literal-$ safety,
+    leading-zero params, error-until-Sync recovery."""
+    import struct as st
+
+    def send(tag, payload):
+        pg.sock.sendall(tag + st.pack(">I", len(payload) + 4) + payload)
+
+    def cstr(s):
+        return s.encode() + b"\x00"
+
+    pg.query("CREATE TABLE q (a int, b text)")
+
+    # Describe(statement) reports the parameter count; Describe(portal)
+    # returns a RowDescription for a SELECT
+    send(b"P", cstr("sel") + cstr("SELECT a, b FROM q WHERE a = $1") + st.pack(">H", 0))
+    send(b"D", b"S" + cstr("sel"))
+    send(b"B", cstr("pp") + cstr("sel") + st.pack(">HH", 0, 1) + st.pack(">i", 1) + b"5" + st.pack(">H", 0))
+    send(b"D", b"P" + cstr("pp"))
+    send(b"S", b"")
+    msgs = pg.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert b"t" in tags  # ParameterDescription
+    tmsg = dict(msgs)[b"t"]
+    (nparams,) = st.unpack(">H", tmsg[:2])
+    assert nparams == 1
+    assert b"T" in tags  # RowDescription for the portal
+
+    # $ inside a string literal must NOT be substituted; leading-zero param
+    # stays a string
+    send(b"P", cstr("") + cstr("INSERT INTO q VALUES ($1, 'cost $2 usd')") + st.pack(">H", 0))
+    send(b"B", cstr("") + cstr("") + st.pack(">HH", 0, 1) + st.pack(">i", 1) + b"1" + st.pack(">H", 0))
+    send(b"E", cstr("") + st.pack(">i", 0))
+    send(b"S", b"")
+    pg.read_until(b"Z")
+    rows, _c, _t, _e = pg.query("SELECT b FROM q WHERE a = 1")
+    assert rows == [("cost $2 usd",)]
+
+    send(b"P", cstr("") + cstr("INSERT INTO q VALUES (2, $1)") + st.pack(">H", 0))
+    send(b"B", cstr("") + cstr("") + st.pack(">HH", 0, 1) + st.pack(">i", 3) + b"007" + st.pack(">H", 0))
+    send(b"E", cstr("") + st.pack(">i", 0))
+    send(b"S", b"")
+    pg.read_until(b"Z")
+    rows, _c, _t, _e = pg.query("SELECT b FROM q WHERE a = 2")
+    assert rows == [("007",)]
+
+    # error enters ignore-until-Sync: the Execute after a failed Bind is
+    # discarded rather than running a stale portal
+    send(b"B", cstr("") + cstr("no_such_stmt") + st.pack(">HH", 0, 0) + st.pack(">H", 0))
+    send(b"E", cstr("") + st.pack(">i", 0))
+    send(b"S", b"")
+    msgs = pg.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert tags.count(b"E") == 1 and b"C" not in tags
+    rows, _c, _t, errors = pg.query("SELECT count(*) FROM q")
+    assert rows == [("2",)] and not errors  # no duplicate insert happened
