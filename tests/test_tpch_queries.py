@@ -1,0 +1,81 @@
+"""More TPC-H-shaped queries through SQL, maintained incrementally vs oracles."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+
+
+@pytest.fixture
+def coord():
+    c = Coordinator()
+    c.execute("CREATE SOURCE tp FROM LOAD GENERATOR TPCH (SCALE FACTOR 0.001)")
+    return c
+
+
+def li_state(c):
+    gen = c.generators[0][0]
+    return gen._lineitem_store  # [orderkey, price_cents, disc_pct, shipdate, qty, partkey]
+
+
+def test_q6_forecast_revenue(coord):
+    """Q6: sum(extendedprice * discount) under range filters."""
+    coord.execute(
+        """CREATE MATERIALIZED VIEW q6 AS
+           SELECT sum(l_extendedprice * l_discount) AS revenue
+           FROM lineitem
+           WHERE l_shipdate >= DATE '1994-01-01' AND l_shipdate < DATE '1995-01-01'
+             AND l_discount BETWEEN 0.05 AND 0.07 AND l_quantity < 24"""
+    )
+    for t in range(2):
+        coord.advance()
+
+    def oracle():
+        lk, ep, dc, sd, qty, pk = (np.asarray(c) for c in li_state(coord))
+        from materialize_tpu.storage.generator import date_num
+
+        lo, hi = date_num(1994, 1, 1), date_num(1995, 1, 1)
+        m = (sd >= lo) & (sd < hi) & (dc >= 5) & (dc <= 7) & (qty < 24)
+        return int((ep[m] * dc[m]).sum())
+
+    rows = coord.execute("SELECT * FROM q6").rows
+    got = round(rows[0][0] * 10_000) if rows else 0
+    assert got == oracle()
+
+
+def test_q1_shaped_aggregation(coord):
+    """Q1-shaped: multi-aggregate GROUP BY with avg over the fact table."""
+    coord.execute(
+        """CREATE MATERIALIZED VIEW q1 AS
+           SELECT l_partkey % 3 AS grp, sum(l_quantity) AS sum_qty,
+                  sum(l_extendedprice) AS sum_price, avg(l_quantity) AS avg_qty,
+                  count(*) AS n
+           FROM lineitem WHERE l_shipdate <= DATE '1998-09-02'
+           GROUP BY l_partkey % 3"""
+    )
+    for t in range(2):
+        coord.advance()
+    lk, ep, dc, sd, qty, pk = (np.asarray(c) for c in li_state(coord))
+    from materialize_tpu.storage.generator import date_num
+
+    cutoff = date_num(1998, 9, 2)
+    m = sd <= cutoff
+    want = {}
+    for g in (0, 1, 2):
+        gm = m & (pk % 3 == g)
+        if gm.any():
+            want[g] = (
+                int(qty[gm].sum()),
+                int(ep[gm].sum()),
+                qty[gm].mean(),
+                int(gm.sum()),
+            )
+    rows = coord.execute("SELECT * FROM q1 ORDER BY grp").rows
+    got = {r[0]: r[1:] for r in rows}
+    assert set(got) == set(want)
+    for g in want:
+        sq, sp, aq, n = want[g]
+        assert got[g][0] == sq
+        assert round(got[g][1] * 100) == sp
+        assert abs(got[g][2] - aq) < 1e-2
+        assert got[g][3] == n
