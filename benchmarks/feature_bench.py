@@ -91,6 +91,7 @@ class FastPathPeek(Scenario):
 
 class MVUpdate(Scenario):
     name = "mv_update"
+    iterations = 45  # capacity shapes stabilize ~25 ticks in; median = steady state
 
     def setup(self, coord):
         coord.execute("CREATE TABLE up_t (g int, v int)")
@@ -105,7 +106,7 @@ class MVUpdate(Scenario):
 
 class DeltaJoinTick(Scenario):
     name = "delta_join_tick"
-    iterations = 10
+    iterations = 30
 
     def setup(self, coord):
         coord.execute("CREATE TABLE dj_a (k int, v int)")
@@ -125,7 +126,7 @@ class DeltaJoinTick(Scenario):
 
 class TopKTick(Scenario):
     name = "topk_tick"
-    iterations = 10
+    iterations = 35
 
     def setup(self, coord):
         coord.execute("CREATE TABLE tk_t (g int, v int)")
@@ -139,7 +140,7 @@ class TopKTick(Scenario):
 
 class RecursiveTick(Scenario):
     name = "recursive_tick"
-    iterations = 5
+    iterations = 18
 
     def setup(self, coord):
         coord.execute("CREATE TABLE rc_e (s int, d int)")

@@ -28,4 +28,21 @@ import jax
 # keep 64-bit data off the critical path where possible.
 jax.config.update("jax_enable_x64", True)
 
+# Kernel shapes recur across ticks, restarts, and processes (pow2-bucketed
+# capacities); the persistent compilation cache turns the per-shape XLA
+# compile into a one-time cost per machine. Opt out with
+# MZT_NO_COMPILE_CACHE=1 (e.g. read-only filesystems).
+import os as _os
+
+if _os.environ.get("MZT_NO_COMPILE_CACHE") != "1":
+    try:
+        _cache_dir = _os.environ.get(
+            "MZT_COMPILE_CACHE_DIR", "/tmp/materialize_tpu_xla_cache"
+        )
+        jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
+    except Exception:
+        pass
+
 __version__ = "0.1.0"

@@ -210,7 +210,7 @@ class Coordinator:
         upper = store.upper
         rows = []
         if upper > frontier and store.arr.batches:
-            for data, t, d in store.arr.merged().to_rows():
+            for data, t, d in store.arr.rows_host():
                 if frontier <= t < upper:
                     rows.append((self._decode_row(data, sub["pq"]), int(t), int(d)))
         sub["frontier"] = upper
@@ -840,7 +840,11 @@ class Coordinator:
             st = self.storage.get(rel.id)
             if st is not None:
                 out: dict = {}
-                for data, t, d in st.snapshot(as_of).to_rows():
+                if hasattr(st, "arr"):  # host path: no XLA for plain scans
+                    triples = st.arr.rows_host(as_of)
+                else:  # introspection collections build a fresh batch
+                    triples = st.snapshot(as_of).to_rows()
+                for data, _t, d in triples:
                     out[data] = out.get(data, 0) + d
                 rows = []
                 for data, cnt in sorted(out.items()):

@@ -95,6 +95,48 @@ class Arrangement:
             out = UpdateBatch.concat(out, b)
         return consolidate(advance_times(out, self.since))
 
+    def rows_host(self, at: int | None = None) -> list[tuple]:
+        """Consolidated (data, time, diff) rows via the HOST path.
+
+        Peeks hit spines whose batch count/capacities change every tick; the
+        device `merged()` would recompile per shape. This path device_gets the
+        live rows and consolidates with the native C++ kernel instead — zero
+        XLA involvement (the PendingPeek cursor-scan analogue,
+        compute_state.rs:1129).
+        """
+        import numpy as np
+
+        from ..utils.native import consolidate_host
+
+        parts: list[dict] = []
+        ncols = None
+        for b in self.batches:
+            h = b.to_host()
+            if len(h["times"]) == 0:
+                continue
+            ncols = len(h["vals"])
+            part = {f"c{i}": np.asarray(c) for i, c in enumerate(h["vals"])}
+            part["times"] = np.asarray(h["times"])
+            part["diffs"] = np.asarray(h["diffs"])
+            parts.append(part)
+        if not parts:
+            return []
+        cols = {
+            k: np.concatenate([p[k] for p in parts]) for k in parts[0]
+        }
+        since = np.uint64(self.since)
+        cols["times"] = np.maximum(cols["times"], since)
+        if at is not None:
+            mask = cols["times"] <= np.uint64(at)
+            cols = {k: v[mask] for k, v in cols.items()}
+        out = consolidate_host(cols)
+        rows = []
+        n = len(out["times"])
+        for i in range(n):
+            data = tuple(out[f"c{j}"][i].item() for j in range(ncols))
+            rows.append((data, int(out["times"][i]), int(out["diffs"][i])))
+        return rows
+
     def count(self) -> int:
         return sum(int(b.count()) for b in self.batches)
 

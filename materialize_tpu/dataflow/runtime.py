@@ -829,21 +829,14 @@ class Dataflow:
         complete time). The analogue of PendingPeek::Index cursor scans
         (src/compute/src/compute_state.rs:1273)."""
         at = self.frontier - 1 if at is None else at
-        err_rows = [
-            r
-            for r in self.index_errs[index_id].merged().to_rows()
-            if r[1] <= at and r[2] != 0
-        ]
         acc: dict[tuple, int] = {}
-        for data, t, d in err_rows:
-            if t <= at:
-                acc[data] = acc.get(data, 0) + d
+        for data, _t, d in self.index_errs[index_id].rows_host(at):
+            acc[data] = acc.get(data, 0) + d
         if any(v > 0 for v in acc.values()):
             raise RuntimeError(f"peek {index_id}: error collection non-empty: {acc}")
         out: dict[tuple, int] = {}
-        for data, t, d in self.index_traces[index_id].merged().to_rows():
-            if t <= at:
-                out[data] = out.get(data, 0) + d
+        for data, _t, d in self.index_traces[index_id].rows_host(at):
+            out[data] = out.get(data, 0) + d
         rows = []
         for data, cnt in sorted(out.items()):
             rows.extend([data] * cnt)
