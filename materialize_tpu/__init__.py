@@ -30,11 +30,17 @@ jax.config.update("jax_enable_x64", True)
 
 # Kernel shapes recur across ticks, restarts, and processes (pow2-bucketed
 # capacities); the persistent compilation cache turns the per-shape XLA
-# compile into a one-time cost per machine. Opt out with
-# MZT_NO_COMPILE_CACHE=1 (e.g. read-only filesystems).
+# compile into a one-time cost per machine. Default-on for accelerators
+# (where compiles cost tens of seconds); on CPU the XLA AOT loader warns
+# about machine-feature mismatches, so it's opt-in there via
+# MZT_COMPILE_CACHE=1. Opt out everywhere with MZT_NO_COMPILE_CACHE=1.
 import os as _os
 
-if _os.environ.get("MZT_NO_COMPILE_CACHE") != "1":
+_want_cache = _os.environ.get("MZT_NO_COMPILE_CACHE") != "1" and (
+    _os.environ.get("JAX_PLATFORMS", "") != "cpu"
+    or _os.environ.get("MZT_COMPILE_CACHE") == "1"
+)
+if _want_cache:
     try:
         _cache_dir = _os.environ.get(
             "MZT_COMPILE_CACHE_DIR", "/tmp/materialize_tpu_xla_cache"

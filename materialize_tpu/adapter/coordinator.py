@@ -818,6 +818,7 @@ class Coordinator:
 
         rows = self._peek_fast_path(rel, as_of)
         if rows is None:
+            self.slow_path_peeks = getattr(self, "slow_path_peeks", 0) + 1
             src_gids = sorted(_collect_gets(rel))
             env = {g: self.storage[g].dtypes for g in src_gids}
             desc = lower_to_dataflow(
@@ -831,8 +832,57 @@ class Coordinator:
         return ExecResult("rows", rows=rows, columns=tuple(c.name for c in pq.scope.cols))
 
     def _peek_fast_path(self, rel, as_of: int):
-        """Bare Get of a maintained materialized view → read its dataflow index
-        (the reference's fast path, peek.rs:119 path (a))."""
+        """Fast-path peeks (peek.rs:119 path (a)): a Get of a maintained
+        collection, optionally under a Map/Filter/Project chain — the chain is
+        applied host-side to the peeked rows (FastPathPlan::PeekExisting with
+        an MFP), avoiding an ephemeral dataflow build entirely."""
+        if not bool(self.configs.get("enable_index_fast_path")):
+            return None
+        # peel a Map/Filter/Project chain down to a Get
+        chain = []
+        base = rel
+        while isinstance(base, (mir.MirMap, mir.MirFilter, mir.MirProject)):
+            chain.append(base)
+            base = base.input
+        if chain and isinstance(base, mir.MirGet):
+            inner_rows = self._peek_fast_path(base, as_of)
+            if inner_rows is None:
+                return None
+            from ..expr.linear import MfpBuilder
+
+            b = MfpBuilder(mir.arity(base))
+            for node in reversed(chain):
+                if isinstance(node, mir.MirMap):
+                    b.add_maps(node.exprs)
+                elif isinstance(node, mir.MirFilter):
+                    b.add_predicates(node.predicates)
+                else:
+                    b.project(node.outputs)
+            mfp = b.finish()
+            out = []
+            for row in inner_rows:
+                cols = list(row)
+                err = None
+                for m in mfp.map_exprs:
+                    try:
+                        cols.append(_eval_scalar_on_row(m, cols))
+                    except Exception as e:
+                        cols.append(None)
+                        err = err or e
+                keep = True
+                for p in mfp.predicates:
+                    try:
+                        ok = bool(_eval_scalar_on_row(p, cols))
+                    except Exception as e:
+                        err = err or e
+                        ok = True  # an erroring predicate errors, not filters
+                    keep = keep and ok
+                if not keep:
+                    continue  # guard semantics: filtered rows cannot error
+                if err is not None:
+                    raise RuntimeError(f"query error: {err}")
+                out.append(tuple(cols[i] for i in mfp.projection))
+            return sorted(out)
         if isinstance(rel, mir.MirGet):
             for mv_gid, df, _src in self.dataflows:
                 if mv_gid == rel.id:
@@ -926,6 +976,21 @@ def _eval_scalar_on_row(e, row: list):
         return e.value
     if isinstance(e, s.CallUnary):
         v = _eval_scalar_on_row(e.expr, row)
+        if e.func in ("extract_year", "extract_month", "extract_day"):
+            # scalar civil-from-days (matches expr.scalar._civil_from_days)
+            z = int(v) + 8035 + 719468
+            era = z // 146097
+            doe = z - era * 146097
+            yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+            y = yoe + era * 400
+            doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+            mp = (5 * doy + 2) // 153
+            d = doy - (153 * mp + 2) // 5 + 1
+            m = mp + (3 if mp < 10 else -9)
+            y = y + (1 if m <= 2 else 0)
+            return {"extract_year": y, "extract_month": m, "extract_day": d}[e.func]
+        if e.func == "sqrt":
+            return float(v) ** 0.5
         return {
             "neg": lambda: -v,
             "not": lambda: not v,

@@ -159,3 +159,23 @@ def test_numeric_fixed_point(coord):
     r = coord.execute("SELECT sum(price * (1 - disc)) FROM li")
     # 100*0.95 + 50*0.90 = 95 + 45 = 140, scale 4
     assert r.rows == [(140.0,)]
+
+
+def test_filtered_peek_uses_fast_path(coord):
+    """WHERE/projection over an MV peeks the index + host MFP — no ephemeral
+    dataflow build (FastPathPlan::PeekExisting with an MFP)."""
+    coord.execute("CREATE TABLE t (g int, v int)")
+    coord.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT g, sum(v) AS s FROM t GROUP BY g"
+    )
+    before = getattr(coord, "slow_path_peeks", 0)
+    r = coord.execute("SELECT s FROM mv WHERE g >= 2 ORDER BY s")
+    assert r.rows == [(20,), (30,)]
+    r = coord.execute("SELECT g, s * 2 FROM mv WHERE s > 10 ORDER BY g")
+    assert r.rows == [(2, 40), (3, 60)]
+    assert getattr(coord, "slow_path_peeks", 0) == before  # all fast-path
+    # the general path still engages for aggregates over the MV
+    r = coord.execute("SELECT sum(s) FROM mv")
+    assert r.rows == [(60,)]
+    assert getattr(coord, "slow_path_peeks", 0) == before + 1
