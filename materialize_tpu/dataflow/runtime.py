@@ -289,6 +289,46 @@ class ReduceNode(Node):
         return [("reduce_accums", 1, self.state.cap, int(self.state.count()))]
 
 
+class FusedMfpReduceNode(Node):
+    """Mfp→Reduce rendered as one compiled tick (ops/fused_reduce.py).
+
+    State capacity is sticky (grow-only pow2) so shapes recur and the jit
+    cache stays warm across ticks.
+    """
+
+    def __init__(self, mfp, expr: lir.Reduce, mfp_out_dtypes: tuple):
+        from ..ops.reduce import AccumState as _AS
+
+        self.mfp = mfp
+        self.key_cols = expr.key_cols
+        self.aggs = expr.aggs
+        key_dtypes = tuple(mfp_out_dtypes[i] for i in expr.key_cols)
+        accum_dtypes = tuple(np.dtype(a.accum_dtype) for a in expr.aggs)
+        self.state = _AS.empty(8, key_dtypes, accum_dtypes)
+        self.state_cap = 8
+
+    def step(self, tick, ins):
+        from ..ops.fused_reduce import fused_mfp_reduce_step
+
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        self.state, out, agg_errs = fused_mfp_reduce_step(
+            self.state, oks, tick, self.mfp, self.key_cols, self.aggs
+        )
+        n = int(self.state.count())
+        if bucket_cap(n) > self.state_cap:
+            self.state_cap = bucket_cap(n)
+        self.state = self.state.with_capacity(self.state_cap)
+        return out, _union([errs, agg_errs])
+
+    def state_info(self):
+        return [("fused_reduce_accums", 1, self.state.cap, int(self.state.count()))]
+
+
 class DistinctNode(Node):
     """ReducePlan::Distinct — project to key cols, then presence per row."""
 
@@ -708,8 +748,17 @@ class Dataflow:
                 ops.append((DeltaJoinNode(e.plan, e.closure, len(refs)), refs))
             return len(ops) - 1
         if isinstance(e, lir.Reduce):
-            ref = self._render(e.input, ops)
             in_dt = self._infer_dtypes(e.input)
+            if (
+                not e.distinct
+                and isinstance(e.input, lir.Mfp)
+                and all(a.func in ("sum", "count") for a in e.aggs)
+            ):
+                # fuse the feeding MFP into the reduce tick (one dispatch)
+                ref = self._render(e.input.input, ops)
+                ops.append((FusedMfpReduceNode(e.input.mfp, e, in_dt), [ref]))
+                return len(ops) - 1
+            ref = self._render(e.input, ops)
             if e.distinct:
                 ops.append((DistinctNode(e.key_cols, in_dt), [ref]))
             else:

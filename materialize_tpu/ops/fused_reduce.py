@@ -1,0 +1,54 @@
+"""Fused MFP→accumulable-reduce tick: ONE compiled program per update.
+
+`SELECT keys…, sum/count(…) FROM src WHERE … GROUP BY keys` is the most
+common materialized-view shape; the host-orchestrated path dispatches ~10
+kernels per tick for it. This fuses filter/map evaluation, contribution
+building, consolidation, state lookup, self-correcting emission and the
+state merge into a single jitted function — the per-tick cost becomes one
+dispatch plus one host count read (the design point of SURVEY.md §7: whole
+steps under jit, host keeps only control).
+
+Capacity discipline: the caller keeps the state capacity STICKY (grow-only,
+pow2), so the (state_cap, delta_cap) shape pairs recur and the jit cache
+stays warm.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+from ..expr.linear import MapFilterProject
+from ..repr.batch import UpdateBatch
+from .consolidate import consolidate
+from .reduce import (
+    AccumState,
+    _contributions,
+    _emit_output,
+    consolidate_accums,
+    lookup_accums,
+)
+
+
+@partial(jax.jit, static_argnames=("mfp", "key_cols", "aggs"))
+def fused_mfp_reduce_step(
+    state: AccumState,
+    delta: UpdateBatch,
+    time,
+    mfp: MapFilterProject,
+    key_cols: tuple[int, ...],
+    aggs: tuple,
+):
+    """(state, Δin, t) → (state', Δout, Δerrs) in one XLA program."""
+    if mfp.is_identity():
+        oks, errs1 = delta, None
+    else:
+        oks, errs1 = mfp.apply(delta)
+    raw, errs2 = _contributions(oks, key_cols, aggs)
+    contrib = consolidate_accums(raw)
+    _found, old_accums, old_nrows = lookup_accums(state, contrib)
+    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
+    new_state = consolidate_accums(AccumState.concat(state, contrib))
+    errs = errs2 if errs1 is None else consolidate(UpdateBatch.concat(errs1, errs2))
+    return new_state, out, errs

@@ -27,11 +27,11 @@ def test_dataflow_metrics():
     ops = c.execute(
         "SELECT operator_type, invocations FROM mz_scheduling_elapsed"
     ).rows
-    assert any(t == "ReduceNode" and n >= 1 for t, n in ops)
+    assert any(t in ("ReduceNode", "FusedMfpReduceNode") and n >= 1 for t, n in ops)
     sizes = c.execute(
         "SELECT arrangement, records FROM mz_arrangement_sizes"
     ).rows
-    assert any(a == "reduce_accums" and r == 1 for a, r in sizes)
+    assert any(a in ("reduce_accums", "fused_reduce_accums") and r == 1 for a, r in sizes)
     # joins show their arrangements too
     c.execute("CREATE TABLE u (g int, w int)")
     c.execute(
