@@ -509,6 +509,32 @@ class Planner:
         if temporal:
             rel = self._plan_temporal(rel, temporal, scope)
 
+        # NOT IN / NOT EXISTS antijoins: rel − (rel ⋉ sub), thresholded
+        for key_ast, sub_pq, is_exists in lifter.antijoins:
+            n = len(scope.cols)
+            if is_exists:
+                key_expr = Literal(1)
+                sub_rel = mir.MirDistinct(
+                    mir.MirProject(
+                        mir.MirMap(sub_pq.mir, (Literal(1),)),
+                        (len(sub_pq.scope.cols),),
+                    )
+                )
+            else:
+                key_expr, _t = self.plan_scalar(key_ast, scope)
+                sub_rel = mir.MirDistinct(sub_pq.mir)
+            rel_k = mir.MirMap(rel, (key_expr,))
+            matched = mir.MirProject(
+                mir.MirJoin(
+                    inputs=(rel_k, sub_rel),
+                    equivalences=((n, n + 1),),
+                ),
+                tuple(range(n)),
+            )
+            rel = mir.MirThreshold(
+                mir.MirUnion((rel, mir.MirNegate(matched)))
+            )
+
         # 3. aggregates?
         has_group = bool(sel.group_by)
         aggs: list[ast.FuncCall] = []
@@ -924,6 +950,9 @@ class _SubqueryLifter:
         self.factors = factors
         self.scopes = scopes
         self.n = 0
+        # (key_ast | None, PlannedQuery, is_exists) — applied as antijoins
+        # after the join is built (NOT IN / NOT EXISTS)
+        self.antijoins: list = []
 
     def _add_factor(self, rel, typ: PType) -> ast.Ident:
         name = f"__sub{self.n}"
@@ -954,13 +983,15 @@ class _SubqueryLifter:
         if isinstance(e, ast.InList):
             subs = [i for i in e.items if isinstance(i, ast.Subquery)]
             if subs:
-                if e.negated:
-                    raise PlanError("NOT IN (SELECT …) not supported yet")
                 if len(e.items) != 1:
                     raise PlanError("IN mixing subquery and literals unsupported")
                 pq = self.planner.plan_query(subs[0].query)
                 if len(pq.scope.cols) != 1:
                     raise PlanError("IN subquery must return one column")
+                if e.negated:
+                    # antijoin: handled at relation level after the join builds
+                    self.antijoins.append((self.rewrite(e.expr), pq, False))
+                    return ast.BoolLit(True)
                 ident = self._add_factor(
                     mir.MirDistinct(pq.mir), pq.scope.cols[0].typ
                 )
@@ -968,6 +999,14 @@ class _SubqueryLifter:
             return replace(e, expr=self.rewrite(e.expr),
                            items=tuple(self.rewrite(i) for i in e.items))
         if isinstance(e, ast.UnaryOp):
+            if (
+                e.op == "not"
+                and isinstance(e.expr, ast.Subquery)
+                and e.expr.exists
+            ):
+                pq = self.planner.plan_query(e.expr.query)
+                self.antijoins.append((None, pq, True))
+                return ast.BoolLit(True)
             return replace(e, expr=self.rewrite(e.expr))
         if isinstance(e, ast.BinaryOp):
             return replace(e, left=self.rewrite(e.left), right=self.rewrite(e.right))

@@ -50,9 +50,9 @@ def test_in_subquery_maintained_in_mv(coord):
     assert coord.execute("SELECT * FROM m ORDER BY a").rows == [(1,), (2,)]
 
 
-def test_not_in_rejected(coord):
-    with pytest.raises(PlanError, match="NOT IN"):
-        coord.execute("SELECT a FROM t WHERE a NOT IN (SELECT x FROM u)")
+def test_not_in_direct(coord):
+    r = coord.execute("SELECT a FROM t WHERE a NOT IN (SELECT x FROM u) ORDER BY a")
+    assert r.rows == [(2,)]
 
 
 def test_stddev_variance(coord):
@@ -68,3 +68,26 @@ def test_stddev_variance(coord):
     assert abs(sp1 - math.sqrt(8 / 3)) < 1e-3
     assert abs(vs1 - 4.0) < 1e-3  # sample variance of {2,4,6}
     assert g2 == 2 and vp2 == 0.0 and vs2 == 0.0  # n=1: samp clamps to 0
+
+
+def test_not_in_antijoin(coord):
+    r = coord.execute("SELECT a FROM t WHERE a NOT IN (SELECT x FROM u) ORDER BY a")
+    assert r.rows == [(2,)]
+    # maintained incrementally
+    coord.execute(
+        "CREATE MATERIALIZED VIEW anti AS SELECT a FROM t WHERE a NOT IN (SELECT x FROM u)"
+    )
+    assert coord.execute("SELECT * FROM anti").rows == [(2,)]
+    coord.execute("INSERT INTO u VALUES (2)")
+    assert coord.execute("SELECT * FROM anti").rows == []
+    coord.execute("DELETE FROM u WHERE x = 2")
+    assert coord.execute("SELECT * FROM anti").rows == [(2,)]
+
+
+def test_not_exists(coord):
+    assert coord.execute(
+        "SELECT count(*) FROM t WHERE NOT EXISTS (SELECT x FROM u WHERE x > 99)"
+    ).rows == [(3,)]
+    assert coord.execute(
+        "SELECT count(*) FROM t WHERE NOT EXISTS (SELECT x FROM u)"
+    ).rows == []
