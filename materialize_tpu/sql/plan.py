@@ -2,8 +2,10 @@
 
 The analogue of the reference's `mz-sql` plan pipeline (name resolution in
 names.rs, HIR construction in plan/query.rs, HIR→MIR decorrelation in
-plan/lowering.rs). This build plans directly to MIR; correlated subqueries are
-not yet decorrelated (uncorrelated EXISTS/IN become semijoins).
+plan/lowering.rs). This build plans directly to MIR; uncorrelated EXISTS/IN
+become semijoins, NOT IN/NOT EXISTS threshold antijoins, and equality-
+correlated scalar subqueries decorrelate into grouped joins (_decorrelate_
+scalar — the Q17 pattern). General correlated decorrelation is future work.
 
 NUMERIC is fixed-point i64 with a tracked decimal scale: literals like 0.05
 plan as Literal(5)@scale2, multiplication adds scales, addition aligns them —
@@ -478,6 +480,7 @@ class Planner:
             conjuncts.extend(_split_and(p))
         if sel.where is not None:
             conjuncts.extend(_split_and(sel.where))
+        conjuncts.extend(lifter.extra_conjuncts)
         temporal = [c for c in conjuncts if _contains_mz_now(c)]
         conjuncts = [c for c in conjuncts if not _contains_mz_now(c)]
         equivs: list[set] = []
@@ -953,6 +956,8 @@ class _SubqueryLifter:
         # (key_ast | None, PlannedQuery, is_exists) — applied as antijoins
         # after the join is built (NOT IN / NOT EXISTS)
         self.antijoins: list = []
+        # equality conjuncts added by decorrelation (joined on in the WHERE)
+        self.extra_conjuncts: list = []
 
     def _add_factor(self, rel, typ: PType) -> ast.Ident:
         name = f"__sub{self.n}"
@@ -960,6 +965,83 @@ class _SubqueryLifter:
         self.factors.append(rel)
         self.scopes.append(Scope([ScopeCol("__sub", name, typ)]))
         return ast.Ident(name, qualifier="__sub")
+
+    def _add_multi_factor(self, rel, cols: list) -> str:
+        """Add a factor with several named columns; returns its qualifier."""
+        qual = f"__subq{self.n}"
+        self.n += 1
+        self.factors.append(rel)
+        self.scopes.append(Scope([ScopeCol(qual, n, t) for n, t in cols]))
+        return qual
+
+    def _decorrelate_scalar(self, q: ast.Query):
+        """Decorrelate `(SELECT agg-expr FROM … WHERE inner = outer AND …)`.
+
+        The classic equality pattern (reference: HIR→MIR decorrelation,
+        src/sql/src/plan/lowering.rs): rewrite to a grouped subquery over the
+        correlation keys and join it on them. Missing groups drop the outer
+        row (consistent with WHERE-context NULL comparisons; this engine has
+        no NULLs).
+        """
+        if q.ctes or q.order_by or q.limit is not None:
+            raise PlanError("unsupported correlated subquery shape")
+        sel = q.body
+        if not isinstance(sel, ast.Select) or sel.group_by or sel.having or len(sel.items) != 1:
+            raise PlanError("unsupported correlated subquery shape")
+        # inner alias universe (syntactic correlation detection)
+        inner_names: set = set()
+        def collect(f):
+            if isinstance(f, ast.TableRef):
+                inner_names.add(f.alias or f.name)
+            elif isinstance(f, ast.JoinClause):
+                collect(f.left)
+                collect(f.right)
+            elif isinstance(f, ast.SubqueryRef):
+                inner_names.add(f.alias)
+        for f in sel.from_:
+            collect(f)
+
+        def is_inner(i: ast.Ident) -> bool:
+            return i.qualifier is not None and i.qualifier in inner_names
+
+        corr: list[tuple[ast.Ident, ast.Ident]] = []  # (inner, outer)
+        residual: list = []
+        for c in _split_and(sel.where) if sel.where is not None else []:
+            if (
+                isinstance(c, ast.BinaryOp) and c.op == "="
+                and isinstance(c.left, ast.Ident) and isinstance(c.right, ast.Ident)
+                and is_inner(c.left) != is_inner(c.right)
+            ):
+                inner, outer = (c.left, c.right) if is_inner(c.left) else (c.right, c.left)
+                corr.append((inner, outer))
+                continue
+            residual.append(c)
+        if not corr:
+            raise PlanError("correlated subquery: no equality correlation found")
+        res_where = None
+        for c in residual:
+            res_where = c if res_where is None else ast.BinaryOp("and", res_where, c)
+        items = tuple(
+            ast.SelectItem(inner, alias=f"__ck{i}") for i, (inner, _o) in enumerate(corr)
+        ) + (ast.SelectItem(sel.items[0].expr, alias="__agg"),)
+        dq = ast.Query(
+            ast.Select(
+                items=items,
+                from_=sel.from_,
+                where=res_where,
+                group_by=tuple(inner for inner, _o in corr),
+            )
+        )
+        pq = self.planner.plan_query(dq)
+        qual = self._add_multi_factor(
+            pq.mir, [(c.name, c.typ) for c in pq.scope.cols]
+        )
+        names = [c.name for c in pq.scope.cols]
+        for i, (_inner, outer) in enumerate(corr):
+            self.extra_conjuncts.append(
+                ast.BinaryOp("=", outer, ast.Ident(names[i], qualifier=qual))
+            )
+        return ast.Ident(names[-1], qualifier=qual)
 
     def rewrite(self, e):
         if e is None or isinstance(
@@ -969,7 +1051,13 @@ class _SubqueryLifter:
         ):
             return e
         if isinstance(e, ast.Subquery):
-            pq = self.planner.plan_query(e.query)
+            try:
+                pq = self.planner.plan_query(e.query)
+            except PlanError as err:
+                if not e.exists and "unknown column" in str(err):
+                    # correlated scalar subquery: try equality decorrelation
+                    return self._decorrelate_scalar(e.query)
+                raise
             if e.exists:
                 one = mir.MirProject(
                     mir.MirMap(pq.mir, (Literal(1),)),

@@ -91,3 +91,44 @@ def test_not_exists(coord):
     assert coord.execute(
         "SELECT count(*) FROM t WHERE NOT EXISTS (SELECT x FROM u)"
     ).rows == []
+
+
+def test_correlated_scalar_subquery_decorrelation(coord):
+    """WHERE v < (SELECT avg over rows with matching key) — the Q17 shape."""
+    coord.execute("CREATE TABLE li (pk int, qty int)")
+    coord.execute(
+        "INSERT INTO li VALUES (1, 2), (1, 10), (1, 30), (2, 5), (2, 7)"
+    )
+    r = coord.execute(
+        """SELECT pk, qty FROM li l
+           WHERE qty < (SELECT avg(l2.qty) FROM li l2 WHERE l2.pk = l.pk)
+           ORDER BY pk, qty"""
+    )
+    # group 1 avg = 14 -> {2, 10}; group 2 avg = 6 -> {5}
+    assert r.rows == [(1, 2), (1, 10), (2, 5)]
+    # maintained incrementally
+    coord.execute(
+        """CREATE MATERIALIZED VIEW below_avg AS
+           SELECT pk, qty FROM li l
+           WHERE qty < (SELECT avg(l2.qty) FROM li l2 WHERE l2.pk = l.pk)"""
+    )
+    coord.execute("INSERT INTO li VALUES (1, 1000)")  # avg(1) jumps to 260.5
+    r = coord.execute("SELECT * FROM below_avg ORDER BY pk, qty")
+    assert r.rows == [(1, 2), (1, 10), (1, 30), (2, 5)]
+
+
+def test_correlated_q17_shape(coord):
+    """0.2 * avg correlated threshold with an outer join filter."""
+    coord.execute("CREATE TABLE l (pk int, price int, qty int)")
+    coord.execute("CREATE TABLE p (pk int, brand int)")
+    coord.execute(
+        "INSERT INTO l VALUES (1, 100, 1), (1, 200, 50), (2, 300, 2), (2, 50, 40)"
+    )
+    coord.execute("INSERT INTO p VALUES (1, 7), (2, 8)")
+    r = coord.execute(
+        """SELECT sum(l.price) FROM l, p
+           WHERE p.pk = l.pk AND p.brand = 7
+             AND l.qty * 5 < (SELECT avg(l2.qty) FROM l l2 WHERE l2.pk = l.pk)"""
+    )
+    # group 1 avg qty = 25.5; rows with qty*5 < 25.5: qty=1 -> price 100
+    assert r.rows == [(100,)]
