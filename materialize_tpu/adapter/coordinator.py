@@ -171,7 +171,29 @@ class Coordinator:
             return ExecResult("status", status="SET")
         if isinstance(stmt, ast.Update):
             return self._update(stmt)
+        if isinstance(stmt, ast.Copy):
+            return self._copy(stmt)
         raise PlanError(f"unsupported statement: {type(stmt).__name__}")
+
+    def _copy(self, stmt: ast.Copy) -> ExecResult:
+        """COPY … TO STDOUT (reference: pgwire COPY + copy_to sinks)."""
+        if stmt.format not in ("csv", "text"):
+            raise PlanError(f"unsupported COPY format {stmt.format}")
+        res = self._select(stmt.query)
+        import csv as _csv
+        import io as _io
+
+        buf = _io.StringIO()
+        if stmt.format == "csv":
+            w = _csv.writer(buf)
+            for row in res.rows:
+                w.writerow(row)
+        else:
+            for row in res.rows:
+                buf.write("\t".join(str(v) for v in row) + "\n")
+        out = ExecResult("copy", columns=res.columns, status=f"COPY {len(res.rows)}")
+        out.copy_data = buf.getvalue()
+        return out
 
     # -- subscriptions ---------------------------------------------------------
     def _subscribe(self, stmt: ast.Subscribe) -> ExecResult:

@@ -90,6 +90,10 @@ class SqlHandler(BaseHTTPRequestHandler):
                                 "col_names": list(r.columns),
                             }
                         )
+                    elif r.kind == "copy":
+                        out.append(
+                            {"copy": getattr(r, "copy_data", ""), "ok": r.status}
+                        )
                     else:
                         out.append({"ok": r.status})
                 return self._reply(200, {"results": out})

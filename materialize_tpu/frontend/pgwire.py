@@ -4,7 +4,8 @@ The analogue of the reference's `mz-pgwire` (src/pgwire/src/server.rs:82
 handle_connection, protocol.rs:145 run): startup handshake (SSLRequest
 politely declined, cleartext), simple-query protocol with text-format
 results, per-statement CommandComplete tags, ErrorResponse + ReadyForQuery
-recovery. Extended query protocol (parse/bind/execute) is a later round.
+recovery, COPY TO STDOUT, and the extended query protocol
+(Parse/Bind/Describe/Execute/Close/Sync with text parameters).
 
 Every real postgres client (psql, psycopg, JDBC) speaking simple queries can
 talk to this.
@@ -227,6 +228,17 @@ class PgConnection:
                 for row in r.rows:
                     self._send_data_row(row)
                 self.sock.sendall(_msg(b"C", _cstr(f"SELECT {len(r.rows)}")))
+            elif r.kind == "copy":
+                # CopyOutResponse (text format), CopyData lines, CopyDone
+                ncols = len(r.columns)
+                self.sock.sendall(
+                    _msg(b"H", b"\x00" + struct.pack(">H", ncols) + b"\x00\x00" * ncols)
+                )
+                data = getattr(r, "copy_data", "")
+                if data:
+                    self.sock.sendall(_msg(b"d", data.encode()))
+                self.sock.sendall(_msg(b"c", b""))
+                self.sock.sendall(_msg(b"C", _cstr(r.status)))
             else:
                 self.sock.sendall(_msg(b"C", _cstr(r.status)))
 

@@ -299,5 +299,13 @@ class ShowVariable:
 
 
 @dataclass(frozen=True)
+class Copy:
+    """COPY (query | table) TO STDOUT [WITH (FORMAT CSV)]."""
+
+    query: Query
+    format: str = "csv"
+
+
+@dataclass(frozen=True)
 class Subscribe:
     query: Query

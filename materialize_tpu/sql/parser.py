@@ -102,6 +102,30 @@ class Parser:
                 self.expect_op("=")
             t = self.next()
             return ast.SetVariable(name, t.value, system=False)
+        if self.peek().kind == "IDENT" and self.peek().value == "copy":
+            self.next()
+            if self.eat_op("("):
+                q = self.parse_query()
+                self.expect_op(")")
+            else:
+                name = self.ident()
+                q = ast.Query(
+                    ast.Select(
+                        items=(ast.SelectItem(ast.Star()),),
+                        from_=(ast.TableRef(name),),
+                    )
+                )
+            self.expect_kw("to")
+            target = self.ident()
+            if target != "stdout":
+                raise ParseError("only COPY … TO STDOUT is supported")
+            fmt = "csv"
+            if self.eat_kw("with"):
+                self.expect_op("(")
+                self.ident()  # format
+                fmt = self.ident()
+                self.expect_op(")")
+            return ast.Copy(q, fmt)
         if self.at_kw("subscribe"):
             self.next()
             self.eat_kw("to")

@@ -231,3 +231,17 @@ def test_extended_protocol_details(pg):
     assert tags.count(b"E") == 1 and b"C" not in tags
     rows, _c, _t, errors = pg.query("SELECT count(*) FROM q")
     assert rows == [("2",)] and not errors  # no duplicate insert happened
+
+
+def test_copy_to_stdout(pg):
+    pg.query("CREATE TABLE ct (a int, b text)")
+    pg.query("INSERT INTO ct VALUES (1, 'x'), (2, 'y')")
+    payload = b"COPY (SELECT a, b FROM ct ORDER BY a) TO STDOUT\x00"
+    import struct as st
+
+    pg.sock.sendall(b"Q" + st.pack(">I", len(payload) + 4) + payload)
+    msgs = pg.read_until(b"Z")
+    tags = [t for t, _ in msgs]
+    assert b"H" in tags and b"d" in tags and b"c" in tags
+    data = b"".join(p for t, p in msgs if t == b"d").decode()
+    assert data == "1,x\r\n2,y\r\n"
