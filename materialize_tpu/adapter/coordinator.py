@@ -794,6 +794,7 @@ class Coordinator:
             if out is not None and out[0] is not None:
                 env[mv_gid] = out[0]
                 self.storage[mv_gid].append(out[0], ts)
+        self._drive_compaction(ts)
         if persist and self.durable:
             from ..persist import Fenced
 
@@ -811,6 +812,25 @@ class Coordinator:
                 raise
             if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
                 self._persist_catalog()
+
+    def _drive_compaction(self, ts: int) -> None:
+        """Advance `since` on dataflow state and storage arrangements, keeping
+        a configured window of history and honoring subscription read holds
+        (the reference's read-policy + AllowCompaction loop,
+        coord/read_policy.rs)."""
+        window = int(self.configs.get("compaction_window"))
+        if window <= 0:
+            return
+        since = ts - window
+        for sub in getattr(self, "subscriptions", {}).values():
+            since = min(since, sub["frontier"] - 1)
+        if since <= 0:
+            return
+        for _gid, df, _src in self.dataflows:
+            df.compact(since)
+        for gid, store in self.storage.items():
+            if hasattr(store, "arr"):
+                store.arr.compact(since)
 
     def advance(self, n_rows: int = 100) -> int:
         """Pull one batch from every generator source and commit it."""

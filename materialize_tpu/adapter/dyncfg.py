@@ -82,6 +82,12 @@ INDEX_FAST_PATH = Config(
 INTROSPECTION = Config(
     "enable_introspection", True, "expose mz_* introspection relations"
 )
+COMPACTION_WINDOW = Config(
+    "compaction_window", 32,
+    "ticks of history retained before arrangements/storage compact "
+    "(read holds from active subscriptions are respected; the AllowCompaction"
+    "/read_policy analogue)"
+)
 MEMORY_LIMIT_MB = Config(
     "memory_limit_mb", 0, "refuse writes when process RSS exceeds this "
     "(0 = off; the memory_limiter.rs watchdog analogue)"
@@ -99,6 +105,7 @@ ALL_CONFIGS = [
     INTROSPECTION,
     LOG_FILTER,
     MEMORY_LIMIT_MB,
+    COMPACTION_WINDOW,
 ]
 
 

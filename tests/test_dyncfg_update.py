@@ -85,3 +85,25 @@ def test_memory_limiter():
     c.execute("ALTER SYSTEM SET memory_limit_mb = 0")  # off again
     c.execute("INSERT INTO t VALUES (1)")
     assert c.execute("SELECT count(*) FROM t").rows == [(1,)]
+
+
+def test_compaction_bounds_history():
+    """Arrangements consolidate history beyond the compaction window; results
+    stay correct and subscriptions' read holds are honored."""
+    c = Coordinator()
+    c.execute("ALTER SYSTEM SET compaction_window = 4")
+    c.execute("CREATE TABLE t (g int, v int)")
+    c.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT g, sum(v) AS s FROM t GROUP BY g"
+    )
+    # churn one group up and down: history would be ~200 rows uncompacted
+    for i in range(50):
+        c.execute(f"INSERT INTO t VALUES (1, {i})")
+        c.execute(f"DELETE FROM t WHERE v = {i}")
+    assert c.execute("SELECT * FROM mv").rows == []
+    # the mv's storage arrangement must have consolidated away the churn
+    store = c.storage[c.catalog.get("mv").global_id]
+    assert store.arr.count() <= 24, f"history not compacted: {store.arr.count()}"
+    # correctness after compaction
+    c.execute("INSERT INTO t VALUES (2, 7)")
+    assert c.execute("SELECT * FROM mv").rows == [(2, 7)]

@@ -1,0 +1,73 @@
+"""Concurrent clients hammering the HTTP frontend (parallel-workload tier).
+
+The analogue of test/parallel-workload + test/race-condition in the
+reference: several threads run DDL/DML/queries concurrently; commands
+serialize through the coordinator lock; the server must stay coherent (every
+response is a well-formed success or SQL error, and final state equals a
+sequential recount).
+"""
+
+import json
+import threading
+import urllib.request
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.frontend import serve
+
+
+def post(base, doc):
+    req = urllib.request.Request(
+        base + "/api/sql",
+        data=json.dumps(doc).encode(),
+        headers={"content-type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read()), r.status
+    except urllib.error.HTTPError as e:
+        return json.loads(e.read()), e.code
+
+
+def test_parallel_workload():
+    coord = Coordinator()
+    httpd = serve(coord, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    post(base, {"query": "CREATE TABLE t (worker int, v int)"})
+    post(
+        base,
+        {"query": "CREATE MATERIALIZED VIEW per_worker AS SELECT worker, count(*) AS n FROM t GROUP BY worker"},
+    )
+
+    N_THREADS, N_OPS = 4, 15
+    failures: list = []
+
+    def worker(wid: int):
+        for i in range(N_OPS):
+            doc, status = post(
+                base, {"query": f"INSERT INTO t VALUES ({wid}, {i})"}
+            )
+            if status != 200:
+                failures.append((wid, i, doc))
+            if i % 5 == 0:
+                doc, status = post(base, {"query": "SELECT count(*) FROM t"})
+                if status != 200:
+                    failures.append((wid, i, doc))
+            if i % 7 == 0:
+                # concurrent DDL: transient view create/drop
+                post(base, {"query": f"CREATE VIEW v_{wid}_{i} AS SELECT worker FROM t"})
+                post(base, {"query": f"DROP view v_{wid}_{i}"})
+
+    threads = [threading.Thread(target=worker, args=(w,)) for w in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not failures, failures[:3]
+
+    doc, _ = post(base, {"query": "SELECT worker, n FROM per_worker ORDER BY worker"})
+    rows = doc["results"][0]["rows"]
+    assert rows == [[w, N_OPS] for w in range(N_THREADS)]
+    doc, _ = post(base, {"query": "SELECT count(*) FROM t"})
+    assert doc["results"][0]["rows"] == [[N_THREADS * N_OPS]]
+    httpd.shutdown()
