@@ -185,7 +185,7 @@ class Coordinator:
 
         buf = _io.StringIO()
         if stmt.format == "csv":
-            w = _csv.writer(buf)
+            w = _csv.writer(buf, lineterminator="\n")  # Postgres COPY uses \n
             for row in res.rows:
                 w.writerow(row)
         else:
@@ -1019,17 +1019,9 @@ def _eval_scalar_on_row(e, row: list):
     if isinstance(e, s.CallUnary):
         v = _eval_scalar_on_row(e.expr, row)
         if e.func in ("extract_year", "extract_month", "extract_day"):
-            # scalar civil-from-days (matches expr.scalar._civil_from_days)
-            z = int(v) + 8035 + 719468
-            era = z // 146097
-            doe = z - era * 146097
-            yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
-            y = yoe + era * 400
-            doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
-            mp = (5 * doy + 2) // 153
-            d = doy - (153 * mp + 2) // 5 + 1
-            m = mp + (3 if mp < 10 else -9)
-            y = y + (1 if m <= 2 else 0)
+            from ..expr.scalar import civil_from_days_int
+
+            y, m, d = civil_from_days_int(int(v))
             return {"extract_year": y, "extract_month": m, "extract_day": d}[e.func]
         if e.func == "sqrt":
             return float(v) ** 0.5

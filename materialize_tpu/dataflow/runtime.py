@@ -897,6 +897,8 @@ class Dataflow:
                 node.compact(since)
         for arr in self.index_traces.values():
             arr.compact(since)
+        for arr in self.index_errs.values():
+            arr.compact(since)
 
 
 def _expr_dtype(expr, col_dtypes):

@@ -183,6 +183,21 @@ def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
 _D1992 = 8035
 
 
+def civil_from_days_int(days: int) -> tuple:
+    """Pure-int (y, m, d) from a day number since 1992-01-01 — the single
+    definition both the device kernel and host fast-path interpreter use."""
+    z = days + _D1992 + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + (3 if mp < 10 else -9)
+    return y + (1 if m <= 2 else 0), m, d
+
+
 def _civil_from_days(days):
     """Exact (y, m, d) from day numbers since 1992-01-01 (Hinnant's
     civil_from_days, pure integer ops — vectorizes on the VPU)."""

@@ -73,6 +73,27 @@ def _scan_params(sql: str) -> list[tuple[int, int, int]]:
     return out
 
 
+def _has_bare_semicolon(sql: str) -> bool:
+    """';' outside string literals and not merely trailing."""
+    body = sql.strip().rstrip(";")
+    i, n = 0, len(body)
+    in_str = False
+    while i < n:
+        c = body[i]
+        if in_str:
+            if c == "'":
+                if i + 1 < n and body[i + 1] == "'":
+                    i += 2
+                    continue
+                in_str = False
+        elif c == "'":
+            in_str = True
+        elif c == ";":
+            return True
+        i += 1
+    return False
+
+
 def _literalize(v: str | None) -> str:
     if v is None:
         return "NULL"
@@ -260,7 +281,7 @@ class PgConnection:
         if name and name in self.statements:
             self._ext_error("42P05", f"prepared statement {name!r} already exists")
             return
-        if ";" in sql.strip().rstrip(";"):
+        if _has_bare_semicolon(sql):
             self._ext_error("42601", "multiple statements not allowed in Parse")
             return
         self.statements[name] = sql
@@ -302,7 +323,7 @@ class PgConnection:
         last = 0
         for start, end, idx in spots:
             out.append(sql[last:start])
-            if idx - 1 < len(params):
+            if 1 <= idx <= len(params):
                 out.append(_literalize(params[idx - 1]))
             else:
                 self._ext_error("08P01", f"parameter ${idx} not bound")

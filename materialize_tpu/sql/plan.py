@@ -458,9 +458,20 @@ class Planner:
         # factors — the decorrelation-lite path (reference: HIR→MIR lowering
         # in src/sql/src/plan/lowering.rs; correlated forms are future work)
         lifter = _SubqueryLifter(self, factors, scopes)
+        # WHERE/ON conjuncts may register antijoins (top level only); other
+        # contexts reject NOT IN/NOT EXISTS instead of silently misplanning
+        new_where = None
+        if sel.where is not None:
+            parts = [lifter.rewrite_conjunct(c) for c in _split_and(sel.where)]
+            for part in parts:
+                new_where = part if new_where is None else ast.BinaryOp("and", new_where, part)
+        on_preds[:] = [
+            _join_and([lifter.rewrite_conjunct(c) for c in _split_and(p_)])
+            for p_ in on_preds
+        ]
         sel = replace(
             sel,
-            where=lifter.rewrite(sel.where) if sel.where is not None else None,
+            where=new_where,
             items=tuple(
                 ast.SelectItem(lifter.rewrite(it.expr), it.alias) for it in sel.items
             ),
@@ -1043,7 +1054,11 @@ class _SubqueryLifter:
             )
         return ast.Ident(names[-1], qualifier=qual)
 
-    def rewrite(self, e):
+    def rewrite_conjunct(self, e):
+        """Rewrite a top-level WHERE/ON conjunct; antijoins allowed here."""
+        return self.rewrite(e, _allow_anti=True)
+
+    def rewrite(self, e, _allow_anti: bool = False):
         if e is None or isinstance(
             e,
             (ast.NumberLit, ast.StringLit, ast.BoolLit, ast.NullLit, ast.DateLit,
@@ -1077,6 +1092,11 @@ class _SubqueryLifter:
                 if len(pq.scope.cols) != 1:
                     raise PlanError("IN subquery must return one column")
                 if e.negated:
+                    if not _allow_anti:
+                        raise PlanError(
+                            "NOT IN (SELECT …) only supported as a top-level "
+                            "WHERE/ON conjunct"
+                        )
                     # antijoin: handled at relation level after the join builds
                     self.antijoins.append((self.rewrite(e.expr), pq, False))
                     return ast.BoolLit(True)
@@ -1092,6 +1112,10 @@ class _SubqueryLifter:
                 and isinstance(e.expr, ast.Subquery)
                 and e.expr.exists
             ):
+                if not _allow_anti:
+                    raise PlanError(
+                        "NOT EXISTS only supported as a top-level WHERE/ON conjunct"
+                    )
                 pq = self.planner.plan_query(e.expr.query)
                 self.antijoins.append((None, pq, True))
                 return ast.BoolLit(True)
@@ -1116,6 +1140,13 @@ class _SubqueryLifter:
                 self.rewrite(e.else_) if e.else_ else None,
             )
         return e
+
+
+def _join_and(parts):
+    out = None
+    for p_ in parts:
+        out = p_ if out is None else ast.BinaryOp("and", out, p_)
+    return out
 
 
 def _split_and(e):

@@ -244,4 +244,4 @@ def test_copy_to_stdout(pg):
     tags = [t for t, _ in msgs]
     assert b"H" in tags and b"d" in tags and b"c" in tags
     data = b"".join(p for t, p in msgs if t == b"d").decode()
-    assert data == "1,x\r\n2,y\r\n"
+    assert data == "1,x\n2,y\n"

@@ -132,3 +132,18 @@ def test_correlated_q17_shape(coord):
     )
     # group 1 avg qty = 25.5; rows with qty*5 < 25.5: qty=1 -> price 100
     assert r.rows == [(100,)]
+
+
+def test_not_in_outside_where_conjunct_rejected(coord):
+    """NOT IN under OR or in the select list must error, not misplan."""
+    with pytest.raises(PlanError, match="top-level"):
+        coord.execute(
+            "SELECT a FROM t WHERE a NOT IN (SELECT x FROM u) OR a = 1"
+        )
+    with pytest.raises(PlanError, match="top-level"):
+        coord.execute("SELECT a, a NOT IN (SELECT x FROM u) FROM t")
+    # AND-connected top-level conjuncts still work
+    r = coord.execute(
+        "SELECT a FROM t WHERE a NOT IN (SELECT x FROM u) AND a > 0 ORDER BY a"
+    )
+    assert r.rows == [(2,)]
