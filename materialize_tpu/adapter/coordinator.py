@@ -980,7 +980,13 @@ class Coordinator:
                 if stmt.stage in ("optimized", "physical")
                 else pq.mir
             )
-            text = explain_mir(rel)
+            if stmt.stage == "physical":
+                src_gids = sorted(_collect_gets(rel))
+                env = {g: self.storage[g].dtypes for g in src_gids}
+                lo = Lowerer(env, self._mono_ids())
+                text = explain_lir(lo.lower(rel))
+            else:
+                text = explain_mir(rel)
             return ExecResult("rows", rows=[(line,) for line in text.splitlines()], columns=("plan",))
         raise PlanError("EXPLAIN supports SELECT only")
 
@@ -1005,6 +1011,47 @@ class Coordinator:
             raise PlanError(f"SHOW {stmt.what} unsupported")
         rows = [(i.name,) for i in self.catalog.items.values() if i.kind in kinds]
         return ExecResult("rows", rows=sorted(rows), columns=("name",))
+
+
+def explain_lir(e, indent: int = 0) -> str:
+    """EXPLAIN PHYSICAL PLAN rendering of a lowered LIR tree."""
+    pad = "  " * indent
+    name = type(e).__name__
+    extra = ""
+    kids = []
+    if isinstance(e, lir.Get):
+        extra = f" {e.id}"
+    elif isinstance(e, lir.Mfp):
+        m = e.mfp
+        extra = f" maps={len(m.map_exprs)} preds={len(m.predicates)}"
+        kids = [e.input]
+    elif isinstance(e, lir.Join):
+        kind = "delta" if isinstance(e.plan, lir.DeltaJoinPlan) else "linear"
+        extra = f" type={kind}"
+        kids = list(e.inputs)
+    elif isinstance(e, lir.Reduce):
+        extra = f" keys={list(e.key_cols)} aggs={[a.func for a in e.aggs]}" + (
+            " distinct" if e.distinct else ""
+        )
+        kids = [e.input]
+    elif isinstance(e, lir.TopK):
+        extra = f" group={list(e.plan.group_cols)} limit={e.plan.limit}" + (
+            " monotonic" if getattr(e, "monotonic", False) else ""
+        )
+        kids = [e.input]
+    elif isinstance(e, (lir.Negate, lir.Threshold, lir.ArrangeBy, lir.TemporalFilter)):
+        kids = [e.input]
+    elif isinstance(e, lir.Union):
+        kids = list(e.inputs)
+    elif isinstance(e, lir.LetRec):
+        extra = f" bindings={len(e.bindings)}"
+        kids = [b[1] for b in e.bindings] + [e.body]
+    elif isinstance(e, lir.Constant):
+        extra = f" rows={len(e.rows)}"
+    lines = [f"{pad}{name}{extra}"]
+    for k in kids:
+        lines.append(explain_lir(k, indent + 1))
+    return "\n".join(lines)
 
 
 def _eval_scalar_on_row(e, row: list):

@@ -179,3 +179,16 @@ def test_filtered_peek_uses_fast_path(coord):
     r = coord.execute("SELECT sum(s) FROM mv")
     assert r.rows == [(60,)]
     assert getattr(coord, "slow_path_peeks", 0) == before + 1
+
+
+def test_explain_physical(coord):
+    coord.execute("CREATE TABLE r0 (a int, b int)")
+    coord.execute("CREATE TABLE r1 (b int, c int)")
+    coord.execute("CREATE TABLE r2 (c int, d int)")
+    r = coord.execute(
+        "EXPLAIN PHYSICAL PLAN FOR SELECT r0.a, sum(r2.d) FROM r0, r1, r2 "
+        "WHERE r0.b = r1.b AND r1.c = r2.c GROUP BY r0.a"
+    )
+    text = "\n".join(row[0] for row in r.rows)
+    assert "Join type=delta" in text
+    assert "Reduce" in text and "sum" in text

@@ -79,3 +79,28 @@ def test_q1_shaped_aggregation(coord):
         assert round(got[g][1] * 100) == sp
         assert abs(got[g][2] - aq) < 1e-2
         assert got[g][3] == n
+
+
+def test_q18_shape_having(coord):
+    """Q18-shaped: join + GROUP BY + HAVING sum threshold."""
+    coord.execute(
+        """CREATE MATERIALIZED VIEW big_orders AS
+           SELECT o_orderkey, o_custkey, sum(l_quantity) AS total_qty
+           FROM orders, lineitem
+           WHERE o_orderkey = l_orderkey
+           GROUP BY o_orderkey, o_custkey
+           HAVING sum(l_quantity) > 150"""
+    )
+    coord.advance()
+    lk, ep, dc, sd, qty, pk = (np.asarray(c) for c in li_state(coord))
+    gen = coord.generators[0][0]
+    ok, ock, od, sp = (np.asarray(c) for c in gen._orders_store)
+    cust_of = dict(zip(ok.tolist(), ock.tolist()))
+    sums: dict = {}
+    for k, q in zip(lk.tolist(), qty.tolist()):
+        sums[k] = sums.get(k, 0) + q
+    want = sorted(
+        (k, cust_of[k], s) for k, s in sums.items() if s > 150 and k in cust_of
+    )
+    got = sorted(coord.execute("SELECT * FROM big_orders").rows)
+    assert got == want
