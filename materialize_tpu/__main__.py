@@ -23,9 +23,23 @@ def cmd_serve(args) -> None:
     from .adapter import Coordinator
     from .frontend import serve
 
-    coord = Coordinator(data_dir=args.data_dir)
+    coord = Coordinator(data_dir=args.data_dir, preflight=args.preflight)
     httpd = serve(coord, host=args.host, port=args.port)
     print(f"materialize_tpu listening on http://{args.host}:{args.port}", flush=True)
+    if args.preflight:
+        # keep catching up until promoted via POST /api/promote (0dt handoff)
+        def catchup_loop():
+            while coord.deploy_state == "catching-up":
+                time.sleep(0.5)
+                try:
+                    with httpd.RequestHandlerClass.lock:
+                        if coord.deploy_state == "catching-up":
+                            coord.catch_up()
+                except Exception as e:
+                    print(f"catch-up error: {e}", file=sys.stderr)
+
+        threading.Thread(target=catchup_loop, daemon=True).start()
+        print("preflight: catching up; POST /api/promote to take over", flush=True)
     if args.pg_port:
         from .frontend.pgwire import serve_pgwire
 
@@ -118,6 +132,8 @@ def main() -> None:
     s.add_argument("--host", default="127.0.0.1")
     s.add_argument("--port", type=int, default=6875)
     s.add_argument("--data-dir", default=None)
+    s.add_argument("--preflight", action="store_true",
+                   help="0dt: boot read-only, catch up, await /api/promote")
     s.add_argument("--pg-port", type=int, default=6877)
     s.add_argument("--advance-every", type=float, default=0.0)
     s.add_argument("--rows", type=int, default=100)

@@ -4,6 +4,7 @@ The reference serves SQL over HTTP/WS next to pgwire
 (src/environmentd/src/http). This server exposes:
 
   POST /api/sql          {"query": "stmt; stmt; …"}  → {"results": […]}
+  POST /api/promote      finish a 0dt handoff (preflight → leader)
   POST /api/subscribe    {"query": "SELECT …"}        → {"subscription_id": …}
   GET  /api/subscribe/<id>/poll                       → {"updates": […], "frontier": N}
   GET  /api/readyz                                    → "ok"
@@ -97,6 +98,13 @@ class SqlHandler(BaseHTTPRequestHandler):
                     else:
                         out.append({"ok": r.status})
                 return self._reply(200, {"results": out})
+            except Exception as e:
+                return self._reply(400, {"error": str(e)})
+        if self.path == "/api/promote":
+            try:
+                with self.lock:
+                    self.coordinator.promote()
+                return self._reply(200, {"state": self.coordinator.deploy_state})
             except Exception as e:
                 return self._reply(400, {"error": str(e)})
         if self.path == "/api/subscribe":

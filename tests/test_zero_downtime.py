@@ -62,3 +62,43 @@ def test_restart_after_promotion_keeps_latest(tmp_path):
     assert c3.execute("SELECT a FROM t ORDER BY a").rows == [(1,), (2,)]
     c3.execute("INSERT INTO t VALUES (3)")
     assert c3.execute("SELECT count(*) FROM t").rows == [(3,)]
+
+
+def test_preflight_via_http(tmp_path):
+    """0dt through the served surface: --preflight semantics + /api/promote."""
+    import json
+    import threading
+    import urllib.request
+
+    from materialize_tpu.frontend import serve
+
+    d = str(tmp_path / "env")
+    old = Coordinator(data_dir=d)
+    old.execute("CREATE TABLE t (a int)")
+    old.execute("INSERT INTO t VALUES (1)")
+
+    new = Coordinator(data_dir=d, preflight=True)
+    httpd = serve(new, port=0)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+
+    def post(path, doc):
+        req = urllib.request.Request(
+            base + path, data=json.dumps(doc).encode(),
+            headers={"content-type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=30) as r:
+                return json.loads(r.read()), r.status
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read()), e.code
+
+    doc, status = post("/api/sql", {"query": "INSERT INTO t VALUES (9)"})
+    assert status == 400 and "read-only" in doc["error"]
+    doc, status = post("/api/promote", {})
+    assert status == 200 and doc["state"] == "leader"
+    doc, status = post("/api/sql", {"query": "INSERT INTO t VALUES (2)"})
+    assert status == 200
+    doc, _ = post("/api/sql", {"query": "SELECT count(*) FROM t"})
+    assert doc["results"][0]["rows"] == [[2]]
+    httpd.shutdown()
