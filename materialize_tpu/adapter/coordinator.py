@@ -530,6 +530,12 @@ class Coordinator:
         return ExecResult("status", status=f"UPDATE {n}")
 
     def _literal_value(self, e, cdesc: ColumnDesc):
+        if cdesc.typ == ColType.STRING and isinstance(
+            e, (ast.NumberLit, ast.BoolLit)
+        ):
+            # coerce non-string literals into text columns (pg casts them)
+            v = e.value if isinstance(e, ast.NumberLit) else str(e.value).lower()
+            return self.catalog.dict.encode(str(v))
         if isinstance(e, ast.NumberLit):
             if cdesc.typ == ColType.NUMERIC:
                 if "." in e.value:

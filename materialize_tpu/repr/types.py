@@ -124,7 +124,10 @@ class StringDictionary:
         return np.array([self.encode(x) for x in xs], dtype=np.int64)
 
     def decode(self, code: int) -> str:
-        return self._strs[int(code)]
+        c = int(code)
+        if not (0 <= c < len(self._strs)):
+            raise ValueError(f"unknown string dictionary code {c}")
+        return self._strs[c]
 
     def decode_many(self, codes) -> list[str]:
         return [self._strs[int(c)] for c in codes]
