@@ -121,18 +121,28 @@ class Coordinator:
         return self.blob is not None and self.consensus is not None
 
     # -- public API ----------------------------------------------------------
-    def execute(self, sql: str) -> ExecResult:
+    def new_session(self):
+        from .dyncfg import SessionConfigs
+
+        return SessionConfigs(self.configs)
+
+    def execute(self, sql: str, session=None) -> ExecResult:
         stmt = parse_statement(sql)
-        return self.execute_stmt(stmt)
+        return self.execute_stmt(stmt, session)
 
-    def execute_script(self, sql: str) -> list[ExecResult]:
-        return [self.execute_stmt(s) for s in parse_statements(sql)]
+    def execute_script(self, sql: str, session=None) -> list[ExecResult]:
+        return [self.execute_stmt(s, session) for s in parse_statements(sql)]
 
-    def execute_stmt(self, stmt) -> ExecResult:
+    def execute_stmt(self, stmt, session=None) -> ExecResult:
         from ..utils.tracing import TRACER
 
+        self._session = session  # per-statement; coordinator is single-threaded
         with TRACER.span(f"execute:{type(stmt).__name__}"):
             return self._execute_stmt_inner(stmt)
+
+    def _cfg(self):
+        """Effective configs: session overlay when a session is active."""
+        return self._session if getattr(self, "_session", None) is not None else self.configs
 
     def _execute_stmt_inner(self, stmt) -> ExecResult:
         if isinstance(stmt, ast.CreateTable):
@@ -160,14 +170,19 @@ class Coordinator:
         if isinstance(stmt, ast.Subscribe):
             return self._subscribe(stmt)
         if isinstance(stmt, ast.SetVariable):
+            target = (
+                self.configs
+                if stmt.system or getattr(self, "_session", None) is None
+                else self._session
+            )
             try:
-                self.configs.set(stmt.name, stmt.value)
+                target.set(stmt.name, stmt.value)
             except KeyError as e:
                 raise PlanError(str(e))
             if stmt.name == "log_filter":
                 from ..utils.tracing import TRACER
 
-                TRACER.set_filter(self.configs.get("log_filter"))
+                TRACER.set_filter(self._cfg().get("log_filter"))
             return ExecResult("status", status="SET")
         if isinstance(stmt, ast.Update):
             return self._update(stmt)
@@ -861,7 +876,7 @@ class Coordinator:
     # -- reads -----------------------------------------------------------------
     def _select(self, query: ast.Query) -> ExecResult:
         pq = self.planner.plan_query(query)
-        rel = optimize(pq.mir, self.configs)
+        rel = optimize(pq.mir, self._cfg())
         as_of = self.oracle.read_ts()
 
         rows = self._peek_fast_path(rel, as_of)
@@ -1007,7 +1022,7 @@ class Coordinator:
         kinds = kind_map.get(stmt.what)
         if kinds is None and stmt.what in self.configs.names():
             return ExecResult(
-                "rows", rows=[(str(self.configs.get(stmt.what)),)], columns=(stmt.what,)
+                "rows", rows=[(str(self._cfg().get(stmt.what)),)], columns=(stmt.what,)
             )
         if kinds is None:
             if stmt.what == "columns" and stmt.on:

@@ -111,3 +111,30 @@ ALL_CONFIGS = [
 
 def default_configs() -> ConfigSet:
     return ConfigSet(ALL_CONFIGS)
+
+
+class SessionConfigs:
+    """Per-session overlay over the system ConfigSet (the reference's session
+    vars vs system vars split, src/sql/src/session/vars): SET writes here,
+    ALTER SYSTEM writes the underlying set; reads check the overlay first."""
+
+    def __init__(self, system: ConfigSet):
+        self.system = system
+        self.overrides: dict = {}
+
+    def get(self, name: str):
+        if name in self.overrides:
+            return self.overrides[name]
+        return self.system.get(name)
+
+    def set(self, name: str, value) -> None:
+        # validate via a scratch set() against the system registry
+        probe = ConfigSet(list(self.system._configs.values()))
+        probe.set(name, value)
+        self.overrides[name] = probe.get(name)
+
+    def reset(self, name: str) -> None:
+        self.overrides.pop(name, None)
+
+    def names(self):
+        return self.system.names()

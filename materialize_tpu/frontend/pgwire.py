@@ -113,6 +113,7 @@ class PgConnection:
         self.sock = sock
         self.coord = coordinator
         self.lock = lock
+        self.session = coordinator.new_session()
         # extended query protocol state (protocol.rs StateMachine analogue)
         self.statements: dict[str, str] = {}  # name -> sql with $n params
         self.portals: dict[str, str] = {}  # name -> bound sql
@@ -233,7 +234,7 @@ class PgConnection:
             return
         try:
             with self.lock:
-                results = self.coord.execute_script(sql)
+                results = self.coord.execute_script(sql, self.session)
         except Exception as e:
             self._send_error("XX000", str(e))
             self._send_ready()
@@ -397,7 +398,7 @@ class PgConnection:
             return
         try:
             with self.lock:
-                results = self.coord.execute_script(sql)
+                results = self.coord.execute_script(sql, self.session)
         except Exception as e:
             self._ext_error("XX000", str(e))
             return

@@ -245,3 +245,27 @@ def test_copy_to_stdout(pg):
     assert b"H" in tags and b"d" in tags and b"c" in tags
     data = b"".join(p for t, p in msgs if t == b"d").decode()
     assert data == "1,x\n2,y\n"
+
+
+def test_session_variables_are_per_connection():
+    coord = Coordinator()
+    srv, _t = serve_pgwire(coord, port=0)
+    port = srv.getsockname()[1]
+    c1, c2 = MiniPgClient(port), MiniPgClient(port)
+    c1.startup()
+    c2.startup()
+    try:
+        c1.query("SET enable_delta_join = false")
+        rows, *_ = c1.query("SHOW enable_delta_join")
+        assert rows == [("False",)]
+        rows, *_ = c2.query("SHOW enable_delta_join")
+        assert rows == [("True",)]  # c2 unaffected
+        # ALTER SYSTEM affects everyone without an override
+        c2.query("ALTER SYSTEM SET enable_delta_join = false")
+        rows, *_ = c2.query("SHOW enable_delta_join")
+        assert rows == [("False",)]
+        c2.query("ALTER SYSTEM SET enable_delta_join = true")
+    finally:
+        c1.close()
+        c2.close()
+        srv.close()
