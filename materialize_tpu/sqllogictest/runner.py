@@ -11,6 +11,8 @@ doc/developer/guide-testing.md:121-196). Supported directives:
   <expected rows, tab- or space-separated>
   hash-threshold N            (ignored)
   halt / skipif / onlyif      (skipif/onlyif respected for 'materialize')
+  $ advance [N]               (testdrive-style action: tick generator
+                               sources N rows forward)
 
 Types string: T=text, I=integer, R=float (per sqllogictest convention).
 """
@@ -63,6 +65,17 @@ def run_slt_text(text: str, coordinator: Coordinator | None = None) -> SltResult
             continue
         if line == "halt":
             break
+        if line.startswith("$"):
+            parts = line[1:].split()
+            if parts and parts[0] == "advance":
+                rows = int(parts[1]) if len(parts) > 1 else 100
+                coord.advance(rows)
+                res.passed += 1
+            else:
+                res.failed += 1
+                res.errors.append(f"unknown action: {line}")
+            i += 1
+            continue
         if line.startswith("skipif"):
             target = line.split()[1] if len(line.split()) > 1 else ""
             if target in ("materialize", "materialize_tpu"):
