@@ -875,6 +875,9 @@ class Coordinator:
 
     # -- reads -----------------------------------------------------------------
     def _select(self, query: ast.Query) -> ExecResult:
+        import time as _time
+
+        t0 = _time.perf_counter_ns()
         pq = self.planner.plan_query(query)
         rel = optimize(pq.mir, self._cfg())
         as_of = self.oracle.read_ts()
@@ -892,7 +895,17 @@ class Coordinator:
             df.step(as_of, snaps)
             rows = df.peek("idx_peek")
         rows = self._finish(rows, pq)
+        self._record_peek(_time.perf_counter_ns() - t0)
         return ExecResult("rows", rows=rows, columns=tuple(c.name for c in pq.scope.cols))
+
+    # power-of-two histogram of peek durations (mz_peek_durations analogue)
+    def _record_peek(self, ns: int) -> None:
+        if not hasattr(self, "peek_histogram"):
+            self.peek_histogram: dict[int, int] = {}
+        bucket = 1
+        while bucket < ns:
+            bucket <<= 1
+        self.peek_histogram[bucket] = self.peek_histogram.get(bucket, 0) + 1
 
     def _peek_fast_path(self, rel, as_of: int):
         """Fast-path peeks (peek.rs:119 path (a)): a Get of a maintained
@@ -994,6 +1007,23 @@ class Coordinator:
     # -- introspection ---------------------------------------------------------
     def _explain(self, stmt: ast.Explain) -> ExecResult:
         inner = stmt.statement
+        if stmt.stage == "timestamp" and isinstance(inner, ast.SelectStatement):
+            pq = self.planner.plan_query(inner.query)
+            rel = optimize(pq.mir, self._cfg())
+            as_of = self.oracle.read_ts()
+            lines = [f"query timestamp: {as_of}", f"oracle read:     {as_of}"]
+            for gid in sorted(_collect_gets(rel)):
+                name = next(
+                    (i.name for i in self.catalog.items.values() if i.global_id == gid),
+                    gid,
+                )
+                st = self.storage.get(gid)
+                upper = getattr(st, "upper", "?")
+                since = getattr(getattr(st, "arr", None), "since", 0)
+                lines.append(f"source {name} ({gid}): [{since}, {upper})")
+            return ExecResult(
+                "rows", rows=[(line,) for line in lines], columns=("timestamp",)
+            )
         if isinstance(inner, ast.SelectStatement):
             pq = self.planner.plan_query(inner.query)
             rel = (
@@ -1019,6 +1049,10 @@ class Coordinator:
             "indexes": ("index",),
             "materialized": ("materialized_view",),
         }
+        if stmt.what == "all":
+            cfg = self._cfg()
+            rows = [(name, str(cfg.get(name))) for name in self.configs.names()]
+            return ExecResult("rows", rows=rows, columns=("name", "setting"))
         kinds = kind_map.get(stmt.what)
         if kinds is None and stmt.what in self.configs.names():
             return ExecResult(

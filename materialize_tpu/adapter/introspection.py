@@ -52,6 +52,10 @@ INTROSPECTION_TABLES = {
         ("name", ColType.STRING),
         ("duration_ns", ColType.INT64),
     ),
+    "mz_peek_durations": _desc(
+        ("bucket_ns_le", ColType.INT64),
+        ("count", ColType.INT64),
+    ),
     "mz_arrangement_sizes": _desc(
         ("dataflow", ColType.STRING),
         ("operator_id", ColType.INT64),
@@ -114,6 +118,8 @@ def introspection_rows(coord, name: str) -> list[tuple]:
             for s in TRACER.recent()
             if s.duration_ns >= 0
         ]
+    if name == "mz_peek_durations":
+        return sorted(getattr(coord, "peek_histogram", {}).items())
     if name == "mz_arrangement_sizes":
         out = []
         for gid, df, _src in coord.dataflows:

@@ -284,6 +284,10 @@ class Parser:
     def parse_explain(self):
         self.expect_kw("explain")
         stage = "optimized"
+        if self.peek().kind == "IDENT" and self.peek().value == "timestamp":
+            self.next()
+            self.eat_kw("for")
+            return ast.Explain("timestamp", self.parse_statement())
         if self.peek().kind == "IDENT" and self.peek().value in ("raw", "decorrelated", "optimized", "physical"):
             stage = self.next().value
             if self.peek().kind == "IDENT" and self.peek().value == "plan":
@@ -293,6 +297,8 @@ class Parser:
 
     def parse_show(self):
         self.expect_kw("show")
+        if self.eat_kw("all"):
+            return ast.Show("all")
         what = self.ident()
         on = None
         if self.eat_kw("from") or self.eat_kw("on"):

@@ -43,3 +43,17 @@ def test_dataflow_metrics():
         "(SELECT id FROM mz_materialized_views WHERE name = 'j')"
     ).rows if False else c.execute("SELECT arrangement FROM mz_arrangement_sizes").rows
     assert any("join" in a[0] for a in sizes)
+
+
+def test_peek_durations_show_all_explain_timestamp():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int)")
+    c.execute("INSERT INTO t VALUES (1)")
+    c.execute("SELECT a FROM t")
+    rows = c.execute("SELECT * FROM mz_peek_durations").rows
+    assert rows and all(cnt >= 1 for _b, cnt in rows)
+    rows = c.execute("SHOW ALL").rows
+    assert ("enable_delta_join", "True") in rows
+    r = c.execute("EXPLAIN TIMESTAMP FOR SELECT a FROM t")
+    text = "\n".join(row[0] for row in r.rows)
+    assert "query timestamp:" in text and "source t" in text
