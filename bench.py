@@ -20,7 +20,7 @@ import time
 import numpy as np
 
 
-def build_tpu_side(sf, ticks, frac, seed):
+def build_tpu_side(sf, ticks, frac, seed, scale=1):
     import jax
 
     import materialize_tpu  # noqa: F401
@@ -32,15 +32,15 @@ def build_tpu_side(sf, ticks, frac, seed):
     init = gen.initial_batches(1)
     n_orders = gen.n_orders
     n_li = len(gen._lineitem_store[0]) if gen._lineitem_store else int(4 * n_orders)
-    per_tick = int(n_orders * frac * 2 * 5.5) + 64  # RF1+RF2 orders + lineitems
+    per_tick = (int(n_orders * frac * 2 * 5.5) + 64) * scale
     caps = Q3Caps(
-        cust=bucket_cap(max(gen.n_customer // 4, 64)),
-        orders=bucket_cap(max(int(n_orders * 0.55), 64)),
-        lineitem=bucket_cap(max(int(n_li * 0.65), 64)),
+        cust=bucket_cap(max(gen.n_customer // 4, 64) * scale),
+        orders=bucket_cap(max(int(n_orders * 0.55), 64) * scale),
+        lineitem=bucket_cap(max(int(n_li * 0.65), 64) * scale),
         delta=bucket_cap(per_tick),
         bucket=1 << 10,
         join_out=bucket_cap(per_tick * 2),
-        groups=bucket_cap(max(int(n_orders * 0.35), 64)),
+        groups=bucket_cap(max(int(n_orders * 0.35), 64) * scale),
     )
     # steady-state ticks never touch customer (TPC-H RF1/RF2): compile the
     # variant with the customer path statically removed
@@ -49,14 +49,22 @@ def build_tpu_side(sf, ticks, frac, seed):
     return gen, init, caps, step, state
 
 
-def run_tpu(sf, ticks, frac, seed=0):
+def run_tpu(sf, ticks, frac, seed=0, scale=1, max_rescale=3):
+    """Measure updates/sec; capacity overflows retry with doubled caps
+    (estimates are data-dependent; a lossy run must never be reported)."""
     import jax
 
-    gen, init, caps, step, state = build_tpu_side(sf, ticks, frac, seed)
+    gen, init, caps, step, state = build_tpu_side(sf, ticks, frac, seed, scale)
     # initial hydration (bulk path, not timed: reference benches steady-state)
     from materialize_tpu.models.fused_q3 import hydrate
 
-    state = hydrate(state, init["customer"], init["orders"], init["lineitem"], 1)
+    try:
+        state = hydrate(state, init["customer"], init["orders"], init["lineitem"], 1)
+    except AssertionError:
+        if max_rescale <= 0:
+            raise
+        print(f"# hydration overflow at scale {scale}; retrying x2", file=sys.stderr)
+        return run_tpu(sf, ticks, frac, seed, scale * 2, max_rescale - 1)
     jax.block_until_ready(state.accum.levels[-1].nrows)
 
     # pre-generate refresh ticks (host generation excluded from timing)
@@ -74,19 +82,28 @@ def run_tpu(sf, ticks, frac, seed=0):
     t0, r0 = refreshes[0]
     state, out, errs, over = step(state, empty_c, r0["orders"], r0["lineitem"], np.uint64(t0))
     jax.block_until_ready(out.diffs)
-    warm_updates = int(r0["orders"].count()) + int(r0["lineitem"].count())
+    if bool(np.asarray(over).any()) and max_rescale > 0:
+        print(f"# warmup overflow at scale {scale}; retrying x2", file=sys.stderr)
+        return run_tpu(sf, ticks, frac, seed, scale * 2, max_rescale - 1)
 
     start = time.perf_counter()
     total = 0
+    any_over = False
     for t, r in refreshes[1:]:
         state, out, errs, over = step(
             state, empty_c, r["orders"], r["lineitem"], np.uint64(t)
         )
         total += int(r["orders"].count()) + int(r["lineitem"].count())
+        any_over = any_over or bool(np.asarray(over).any())
     jax.block_until_ready(out.diffs)
     elapsed = time.perf_counter() - start
-    if bool(np.asarray(over).any()):
-        print("WARNING: overflow during timed ticks", file=sys.stderr)
+    if any_over:
+        # results would be lossy: rerun everything with doubled capacities
+        if max_rescale <= 0:
+            print("WARNING: overflow persists at max rescale", file=sys.stderr)
+        else:
+            print(f"# tick overflow at scale {scale}; retrying x2", file=sys.stderr)
+            return run_tpu(sf, ticks, frac, seed, scale * 2, max_rescale - 1)
     return total / elapsed, total, elapsed
 
 
