@@ -194,6 +194,13 @@ class Query:
 
 
 @dataclass(frozen=True)
+class Values:
+    """VALUES (…), (…) as a query body."""
+
+    rows: tuple
+
+
+@dataclass(frozen=True)
 class SetOp:
     op: str  # union | union_all | except | except_all | intersect | intersect_all
     left: Any

@@ -69,7 +69,7 @@ class Parser:
 
     # -- entry ----------------------------------------------------------------
     def parse_statement(self):
-        if self.at_kw("select", "with") or self.at_op("("):
+        if self.at_kw("select", "with", "values") or self.at_op("("):
             return ast.SelectStatement(self.parse_query())
         if self.at_kw("create"):
             return self.parse_create()
@@ -395,6 +395,8 @@ class Parser:
             q = self.parse_set_expr()
             self.expect_op(")")
             return q
+        if self.at_kw("values"):
+            return self.parse_values()
         self.expect_kw("select")
         distinct = False
         if self.eat_kw("distinct"):
@@ -443,6 +445,21 @@ class Parser:
             group_by = tuple(gb)
         having = self.parse_expr() if self.eat_kw("having") else None
         return ast.Select(tuple(items), from_, where, group_by, having, distinct)
+
+    def parse_values(self):
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = []
+            while not self.at_op(")"):
+                row.append(self.parse_expr())
+                self.eat_op(",")
+            self.expect_op(")")
+            rows.append(tuple(row))
+            if not self.eat_op(","):
+                break
+        return ast.Values(tuple(rows))
 
     def parse_table_factor_with_joins(self):
         left = self.parse_table_factor()

@@ -408,6 +408,8 @@ class Planner:
     def plan_set_expr(self, body):
         if isinstance(body, ast.Select):
             return self.plan_select(body)
+        if isinstance(body, ast.Values):
+            return self.plan_values(body)
         if isinstance(body, ast.SetOp):
             lrel, lscope = self.plan_set_expr(body.left)
             rrel, rscope = self.plan_set_expr(body.right)
@@ -443,6 +445,47 @@ class Planner:
                 rel = pq.mir
             return rel, pq.scope
         raise PlanError(f"unsupported query body {type(body).__name__}")
+
+    def plan_values(self, v: ast.Values):
+        if not v.rows:
+            raise PlanError("VALUES needs at least one row")
+        arity = len(v.rows[0])
+        planned_rows = []
+        types: list = [None] * arity
+        for row in v.rows:
+            if len(row) != arity:
+                raise PlanError("VALUES rows must have equal arity")
+            vals = []
+            for i, e in enumerate(row):
+                p, t = self.plan_scalar(e, Scope([]))
+                if not isinstance(p, Literal):
+                    raise PlanError("VALUES entries must be literals")
+                if types[i] is None:
+                    types[i] = t
+                elif types[i].col != t.col:
+                    # align int/numeric mixes by rescaling to the wider scale
+                    if {types[i].col, t.col} == {ColType.INT64, ColType.NUMERIC}:
+                        types[i] = t if t.col == ColType.NUMERIC else types[i]
+                    else:
+                        raise PlanError("VALUES column types must match")
+                vals.append((p.value, t))
+            planned_rows.append(vals)
+        rows = []
+        for vals in planned_rows:
+            data = []
+            for i, (raw, t) in enumerate(vals):
+                target = types[i]
+                if target.col == ColType.NUMERIC and t.scale != target.scale:
+                    raw = raw * 10 ** (target.scale - t.scale)
+                data.append(raw)
+            rows.append((tuple(data), 1))
+        rel = mir.MirConstant(
+            rows=tuple(rows), dtypes=tuple(t.dtype for t in types)
+        )
+        scope = Scope(
+            [ScopeCol(None, f"column{i+1}", t) for i, t in enumerate(types)]
+        )
+        return rel, scope
 
     def plan_select(self, sel: ast.Select):
         # 1. FROM: flatten factors + inner joins into one MirJoin

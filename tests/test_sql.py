@@ -192,3 +192,19 @@ def test_explain_physical(coord):
     text = "\n".join(row[0] for row in r.rows)
     assert "Join type=delta" in text
     assert "Reduce" in text and "sum" in text
+
+
+def test_values_lists(coord):
+    r = coord.execute("VALUES (1, 'a'), (2, 'b')")
+    assert r.rows == [(1, "a"), (2, "b")]
+    r = coord.execute("SELECT column1 * 10 FROM (VALUES (1), (2), (3)) v ORDER BY 1")
+    assert r.rows == [(10,), (20,), (30,)]
+    r = coord.execute("SELECT sum(column1) FROM (VALUES (1.5), (2)) v")
+    assert r.rows == [(3.5,)]
+    # joins against VALUES
+    coord.execute("CREATE TABLE t (a int)")
+    coord.execute("INSERT INTO t VALUES (1), (3)")
+    r = coord.execute(
+        "SELECT t.a FROM t, (VALUES (1), (2)) v WHERE t.a = v.column1"
+    )
+    assert r.rows == [(1,)]
