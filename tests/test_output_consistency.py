@@ -74,12 +74,24 @@ class QueryGen:
             if self.rng.random() < 0.5:
                 q += f" AND {self.predicate(['t1.b', 't2.y'])}"
             return q
-        if kind < 0.9:
+        if kind < 0.82:
             # set op over same-arity selects
             op = self.rng.choice(
                 ["UNION", "UNION ALL", "EXCEPT", "INTERSECT"]
             )
             return f"SELECT a FROM t1 {op} SELECT x FROM t2"
+        if kind < 0.88:
+            # IN / NOT IN subquery (top-level conjunct)
+            neg = "NOT " if self.rng.random() < 0.5 else ""
+            return f"SELECT a, b FROM t1 WHERE a {neg}IN (SELECT x FROM t2)"
+        if kind < 0.93:
+            # scalar subquery comparison
+            agg = self.rng.choice(["min", "max", "count"])
+            return f"SELECT a FROM t1 WHERE b > (SELECT {agg}(y) FROM t2)"
+        if kind < 0.97:
+            # deterministic ORDER BY + LIMIT (full column order disambiguates)
+            k = int(self.rng.integers(1, 8))
+            return f"SELECT a, b, c FROM t1 ORDER BY a, b, c LIMIT {k}"
         # distinct
         return "SELECT DISTINCT b FROM t1"
 
@@ -118,11 +130,14 @@ def test_output_consistency_vs_sqlite(seed):
     coord.execute(f"INSERT INTO t2 VALUES {vals2}")
 
     gen = QueryGen(rng)
-    n_q = 25
+    n_q = 30
     for qi in range(n_q):
         q = gen.query()
-        want = sorted(tuple(int(v) for v in row) for row in lite.execute(q))
-        got = sorted(
-            tuple(int(v) for v in row) for row in coord.execute(q).rows
+        ordered = "ORDER BY" in q
+        lite_rows = [tuple(int(v) for v in row) for row in lite.execute(q)]
+        mzt_rows = [tuple(int(v) for v in row) for row in coord.execute(q).rows]
+        if not ordered:
+            lite_rows, mzt_rows = sorted(lite_rows), sorted(mzt_rows)
+        assert mzt_rows == lite_rows, (
+            f"query #{qi} diverged: {q}\n got:  {mzt_rows}\n want: {lite_rows}"
         )
-        assert got == want, f"query #{qi} diverged: {q}\n got:  {got}\n want: {want}"
