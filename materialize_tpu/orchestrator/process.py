@@ -9,11 +9,24 @@ ensure/drop semantics, and health checks.
 
 from __future__ import annotations
 
+import os
 import socket
 import subprocess
 import sys
 import time
 from dataclasses import dataclass, field
+
+
+def _replica_env(cpu: bool) -> dict:
+    """Environment for spawned replicas. With cpu=True the platform must be
+    pinned BEFORE interpreter start: materialize_tpu's import-time gates (the
+    persistent compile cache with its AOT SIGILL risk, the axon plugin) read
+    the env before clusterd's --cpu flag is ever parsed."""
+    env = dict(os.environ)
+    if cpu:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["MZT_NO_COMPILE_CACHE"] = "1"
+    return env
 
 
 def _free_port() -> int:
@@ -51,7 +64,7 @@ class ProcessOrchestrator:
             ]
             if self.cpu:
                 args.append("--cpu")
-            proc = subprocess.Popen(args)
+            proc = subprocess.Popen(args, env=_replica_env(self.cpu))
             svc.processes.append(proc)
             svc.ports.append(port)
         while len(svc.processes) > scale:
@@ -92,7 +105,7 @@ class ProcessOrchestrator:
         ]
         if self.cpu:
             args.append("--cpu")
-        svc.processes[idx] = subprocess.Popen(args)
+        svc.processes[idx] = subprocess.Popen(args, env=_replica_env(self.cpu))
         self._await_ready(svc)
 
     def drop_service(self, name: str) -> None:
