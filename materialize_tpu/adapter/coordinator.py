@@ -470,7 +470,10 @@ class Coordinator:
             return ExecResult("status", status="DELETE 0")
         desc = item.desc
         cols = tuple(
-            np.array([r[i] if not isinstance(r[i], str) else self.catalog.dict.encode(r[i]) for r in res.rows], dtype=desc.columns[i].dtype)
+            np.array(
+                [self._encode_val(r[i], desc.columns[i]) for r in res.rows],
+                dtype=desc.columns[i].dtype,
+            )
             for i in range(desc.arity)
         )
         ts = self.oracle.write_ts()
@@ -478,6 +481,17 @@ class Coordinator:
         batch = UpdateBatch.build((), cols, np.full(n, ts), -np.ones(n, dtype=np.int64))
         self._apply_writes({item.global_id: batch}, ts)
         return ExecResult("status", status=f"DELETE {n}")
+
+    def _encode_val(self, v, cd):
+        """Re-encode a decoded row value to its storage representation:
+        strings to dictionary codes, NUMERIC floats back to fixed-point.
+        Decoded SELECT rows carry NUMERIC as scaled floats; retractions and
+        rewrites must target the stored fixed-point value exactly."""
+        if isinstance(v, str):
+            return self.catalog.dict.encode(v)
+        if cd.typ == ColType.NUMERIC and isinstance(v, float):
+            return int(round(v * 10**cd.scale))
+        return v
 
     def _update(self, stmt: ast.Update) -> ExecResult:
         """UPDATE = retract matching rows + insert modified versions (the
@@ -497,15 +511,7 @@ class Coordinator:
             return ExecResult("status", status="UPDATE 0")
         desc = item.desc
         assign = {col: e for col, e in stmt.assignments}
-        enc = self.catalog.dict.encode
-
-        def encode_val(v, cd):
-            if isinstance(v, str):
-                return enc(v)
-            if cd.typ == ColType.NUMERIC and isinstance(v, float):
-                return int(round(v * 10**cd.scale))
-            return v
-
+        encode_val = self._encode_val
         old_cols = [[] for _ in range(desc.arity)]
         new_cols = [[] for _ in range(desc.arity)]
         from ..sql.plan import Scope, ScopeCol, PType
@@ -802,6 +808,19 @@ class Coordinator:
         if limit:
             MemoryLimiter(limit).check()
         env = dict(writes)
+        # Durability first: base-table writes hit their shards BEFORE any
+        # in-memory state is touched, so a fenced/failed CAS can never leave
+        # this process serving phantom writes that were never made durable.
+        # Derived MV shards are persisted after stepping; they are recomputable
+        # from the base shards on restart (the reference's persist_sink is
+        # likewise self-correcting against shard contents). The catalog (with
+        # the string dictionary) goes first of all: batches may reference
+        # freshly minted dictionary codes, which must never outrun the durable
+        # dictionary that decodes them.
+        if persist and self.durable:
+            if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
+                self._persist_catalog()
+            self._persist_batches(writes, ts)
         for gid, batch in writes.items():
             self.storage[gid].append(batch, ts)
         for mv_gid, df, src_gids in self.dataflows:
@@ -817,22 +836,27 @@ class Coordinator:
                 self.storage[mv_gid].append(out[0], ts)
         self._drive_compaction(ts)
         if persist and self.durable:
-            from ..persist import Fenced
-
-            try:
-                for gid, batch in env.items():
-                    m = self._shard(gid)
-                    h = batch.to_host()
-                    cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
-                    cols["times"] = h["times"]
-                    cols["diffs"] = h["diffs"]
-                    lower = m.upper()
-                    m.compare_and_append(cols, lower, ts + 1, epoch=self.epoch)
-            except Fenced:
-                self.deploy_state = "fenced"
-                raise
+            derived = {g: b for g, b in env.items() if g not in writes}
+            if derived:
+                self._persist_batches(derived, ts)
             if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
                 self._persist_catalog()
+
+    def _persist_batches(self, batches: dict[str, UpdateBatch], ts: int) -> None:
+        from ..persist import Fenced
+
+        try:
+            for gid, batch in batches.items():
+                m = self._shard(gid)
+                h = batch.to_host()
+                cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
+                cols["times"] = h["times"]
+                cols["diffs"] = h["diffs"]
+                lower = m.upper()
+                m.compare_and_append(cols, lower, ts + 1, epoch=self.epoch)
+        except Fenced:
+            self.deploy_state = "fenced"
+            raise
 
     def _drive_compaction(self, ts: int) -> None:
         """Advance `since` on dataflow state and storage arrangements, keeping
@@ -972,10 +996,9 @@ class Coordinator:
                     triples = st.snapshot(as_of).to_rows()
                 for data, _t, d in triples:
                     out[data] = out.get(data, 0) + d
-                rows = []
-                for data, cnt in sorted(out.items()):
-                    rows.extend([data] * cnt)
-                return rows
+                from ..dataflow.runtime import materialize_counts
+
+                return materialize_counts(out, rel.id)
         return None
 
     def _finish(self, rows: list, pq: PlannedQuery) -> list:

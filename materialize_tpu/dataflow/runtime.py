@@ -632,6 +632,20 @@ class LetRecNode(Node):
         return out, errs
 
 
+def materialize_counts(acc: dict, label: str) -> list[tuple]:
+    """Expand {row: multiplicity} into sorted rows; negative multiplicities
+    mean upstream inconsistency and error (the reference surfaces these as
+    'Invalid data in source, saw retractions' rather than masking)."""
+    rows: list[tuple] = []
+    for data, cnt in sorted(acc.items()):
+        if cnt < 0:
+            raise RuntimeError(
+                f"peek {label}: negative multiplicity {cnt} for {data}"
+            )
+        rows.extend([data] * cnt)
+    return rows
+
+
 def _retime(batch: UpdateBatch, tick: int) -> UpdateBatch:
     """Overwrite live rows' times with the outer tick (iteration timestamps
     are scope-private, like the inner coordinate of a product timestamp)."""
@@ -674,6 +688,7 @@ class Dataflow:
             self.dtypes[sid] = tuple(dts)
         for bd in desc.objects_to_build:
             ops = []
+            self._memo: dict[int, object] = {}
             out_ref = self._render(bd.plan, ops)
             self.builds.append((bd.id, ops, out_ref))
             self.dtypes[bd.id] = tuple(bd.dtypes)
@@ -717,7 +732,21 @@ class Dataflow:
     # -- rendering ---------------------------------------------------------
     def _render(self, expr, ops: list):
         """Append (node, input_refs) entries; return a ref (int = op index,
-        str = imported/built id)."""
+        str = imported/built id). A plan subtree referenced from several
+        places (the lowerer reuses node objects, e.g. the default-row pattern
+        and reduce collation) renders ONCE and is shared by ref — the
+        arrangement-sharing analogue of the reference's CollectionBundle
+        reuse (render/context.rs)."""
+        e = expr
+        memo_key = id(e)
+        hit = self._memo.get(memo_key)
+        if hit is not None:
+            return hit
+        ref = self._render_new(e, ops)
+        self._memo[memo_key] = ref
+        return ref
+
+    def _render_new(self, expr, ops: list):
         e = expr
         if isinstance(e, lir.Get):
             return e.id
@@ -886,10 +915,7 @@ class Dataflow:
         out: dict[tuple, int] = {}
         for data, _t, d in self.index_traces[index_id].rows_host(at):
             out[data] = out.get(data, 0) + d
-        rows = []
-        for data, cnt in sorted(out.items()):
-            rows.extend([data] * cnt)
-        return rows
+        return materialize_counts(out, index_id)
 
     def compact(self, since: int) -> None:
         for _obj, ops, _ref in self.builds:

@@ -224,9 +224,16 @@ class ShardMachine:
                 return
         raise RuntimeError("downgrade_since: CAS contention")
 
-    def compact(self, max_retries: int = 8) -> None:
+    def compact(self) -> None:
         """Merge all batches ≤ since into one consolidated batch (reference:
-        persist compaction, internal/compact.rs — simplified single pass)."""
+        persist compaction, internal/compact.rs — simplified single pass).
+
+        The replacement manifest is derived from exactly the state the CAS is
+        conditioned on; if the CAS loses (concurrent compare_and_append moved
+        the shard), compaction aborts — retrying with a stale manifest would
+        roll back the racing writer's upper/batches. The next maintenance pass
+        recomputes from scratch.
+        """
         seqno, state = self.fetch_state()
         mergeable = [b for b in state.batches if b.count]
         if len(mergeable) <= 1:
@@ -254,13 +261,11 @@ class ShardMachine:
             batches=keep + ([HollowBatch(new_key, lower, upper, n)] if n else []),
             epoch=state.epoch,
         )
-        for _ in range(max_retries):
-            if self.consensus.compare_and_set(self._key, seqno, new_state.encode()):
-                for b in mergeable:
-                    self.blob.delete(b.key)
-                return
-            seqno, state = self.fetch_state()
-        raise RuntimeError("compact: CAS contention")
+        if self.consensus.compare_and_set(self._key, seqno, new_state.encode()):
+            for b in mergeable:
+                self.blob.delete(b.key)
+        elif n:
+            self.blob.delete(new_key)
 
 
 def _consolidate_host(cols: dict) -> dict:

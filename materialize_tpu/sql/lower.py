@@ -173,6 +173,55 @@ class Lowerer:
         raise TypeError(f"lower: {type(e).__name__}")
 
     def lower_reduce(self, e: mir.MirReduce):
+        result = self._lower_reduce_inner(e)
+        if e.group_key or not e.aggregates:
+            return result
+        if not all(a.func == "count" for a in e.aggregates):
+            # sum/min/max/avg over empty input are NULL in SQL; until NULL
+            # semantics land there is no representable default (0 would
+            # fabricate an out-of-domain value, and avg's sum/count division
+            # would error). Documented gap: no row.
+            return result
+        return self._with_default_row(result, e)
+
+    def _with_default_row(self, result, e: mir.MirReduce):
+        """Global (no GROUP BY) COUNT returns one default row (0) over empty
+        input. The reference's reduce lowering unions a default row minus an
+        existence marker (lowering.rs empty-key pattern):
+
+            result ∪ π_aggs(default − (default ⋈ marker))
+
+        where marker is DISTINCT over a constant column of result (nonempty
+        iff result is), so exactly one branch survives.
+        """
+        n = len(e.aggregates)
+        out_dtypes = self.dtypes(e)
+        defaults = tuple(
+            0 if np.issubdtype(dt, np.integer) else np.float32(0.0)
+            for dt in out_dtypes
+        )
+        b = MfpBuilder(n)
+        b.add_maps((Literal(1),))
+        b.project((n,))
+        marker = lir.Reduce(lir.Mfp(result, b.finish()), key_cols=(0,), distinct=True)
+        default_marked = lir.Constant(
+            rows=(((1,) + defaults, 0, 1),), dtypes=(I64,) + tuple(out_dtypes)
+        )
+        jb = MfpBuilder(2 + n)
+        jb.project(tuple(range(1 + n)))
+        joined = lir.Join(
+            inputs=(default_marked, marker),
+            plan=lir.LinearJoinPlan(
+                stages=(lir.JoinStage(stream_key=(0,), lookup_key=(0,)),)
+            ),
+            closure=jb.finish(),
+        )
+        anti = lir.Union((default_marked, lir.Negate(joined)))
+        db = MfpBuilder(1 + n)
+        db.project(tuple(range(1, 1 + n)))
+        return lir.Union((result, lir.Mfp(anti, db.finish())))
+
+    def _lower_reduce_inner(self, e: mir.MirReduce):
         """Split aggregates into accumulable and hierarchical parts.
 
         Mirrors ReducePlan construction (plan/reduce.rs:130): Accumulable for

@@ -28,7 +28,7 @@ def test_exists(coord):
     ).rows == [(3,)]
     assert coord.execute(
         "SELECT count(*) FROM t WHERE EXISTS (SELECT x FROM u WHERE x > 99)"
-    ).rows == []  # empty-group aggregate: no row (documented gap vs SQL)
+    ).rows == [(0,)]  # global aggregate over empty input: one default row
 
 
 def test_scalar_subquery(coord):
@@ -90,7 +90,7 @@ def test_not_exists(coord):
     ).rows == [(3,)]
     assert coord.execute(
         "SELECT count(*) FROM t WHERE NOT EXISTS (SELECT x FROM u)"
-    ).rows == []
+    ).rows == [(0,)]
 
 
 def test_correlated_scalar_subquery_decorrelation(coord):
