@@ -391,10 +391,10 @@ class Coordinator:
         desc = lower_to_dataflow(
             gid, rel, env, src_gids, index_key=(), as_of=0, mono_ids=self._mono_ids()
         )
-        df = Dataflow(desc)
         # hydrate: snapshot all inputs at the current read timestamp
         as_of = self.oracle.read_ts()
         snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
+        df = self._make_dataflow(desc, snaps)
         results = df.step(as_of, snaps)
         self.storage[gid] = StorageCollection(pq.desc.dtypes)
         out = results.get(gid)
@@ -442,7 +442,10 @@ class Coordinator:
                 vals[pos] = self._literal_value(e, desc.columns[pos])
             for i, v in enumerate(vals):
                 if v is None:
-                    raise PlanError("missing column value (no defaults yet)")
+                    # unmentioned column: SQL default is NULL
+                    from ..expr.scalar import null_sentinel
+
+                    v = null_sentinel(desc.columns[i].dtype)
                 cols[i].append(v)
         arrays = tuple(
             np.array(c, dtype=desc.columns[i].dtype) for i, c in enumerate(cols)
@@ -482,11 +485,36 @@ class Coordinator:
         self._apply_writes({item.global_id: batch}, ts)
         return ExecResult("status", status=f"DELETE {n}")
 
+    def _make_dataflow(self, desc, snaps: dict | None = None):
+        """Render a DataflowDescription: the fused single-program path when
+        enabled and expressible, else the host-orchestrated operator graph
+        (the rendering-choice analogue of ENABLE_MZ_JOIN_CORE)."""
+        if bool(self.configs.get("enable_fused_render")):
+            from ..dataflow.fused import FusedDataflow, FusedUnsupported
+
+            try:
+                df = FusedDataflow(desc)
+                if snaps:
+                    # pre-size so the hydration tick doesn't ladder through
+                    # doubling retries on large input snapshots
+                    df.ensure_delta_capacity(
+                        max((int(b.count()) for b in snaps.values()), default=0)
+                    )
+                return df
+            except FusedUnsupported:
+                pass
+        return Dataflow(desc)
+
     def _encode_val(self, v, cd):
         """Re-encode a decoded row value to its storage representation:
-        strings to dictionary codes, NUMERIC floats back to fixed-point.
-        Decoded SELECT rows carry NUMERIC as scaled floats; retractions and
-        rewrites must target the stored fixed-point value exactly."""
+        strings to dictionary codes, NUMERIC floats back to fixed-point,
+        None back to the dtype's NULL sentinel. Decoded SELECT rows carry
+        NUMERIC as scaled floats; retractions and rewrites must target the
+        stored fixed-point value exactly."""
+        if v is None:
+            from ..expr.scalar import null_sentinel
+
+            return null_sentinel(cd.dtype)
         if isinstance(v, str):
             return self.catalog.dict.encode(v)
         if cd.typ == ColType.NUMERIC and isinstance(v, float):
@@ -526,12 +554,18 @@ class Coordinator:
             encoded = [encode_val(v, desc.columns[i]) for i, v in enumerate(row)]
             for i in range(desc.arity):
                 old_cols[i].append(encoded[i])
+            # evaluation happens in None-space (decoded rows carry None for
+            # NULL) so the interpreter never has to guess sentinel widths;
+            # results re-encode (None -> sentinel) below
+            eval_row = [
+                None if row[i] is None else encoded[i] for i in range(desc.arity)
+            ]
             newrow = list(encoded)
             for i, c in enumerate(desc.columns):
                 if c.name in assign:
                     # evaluate assignment expression against the OLD row
                     e, _t = self.planner.plan_scalar(assign[c.name], scope)
-                    newrow[i] = _eval_scalar_on_row(e, encoded)
+                    newrow[i] = encode_val(_eval_scalar_on_row(e, eval_row), c)
             for i in range(desc.arity):
                 new_cols[i].append(newrow[i])
         import numpy as _np
@@ -551,6 +585,10 @@ class Coordinator:
         return ExecResult("status", status=f"UPDATE {n}")
 
     def _literal_value(self, e, cdesc: ColumnDesc):
+        if isinstance(e, ast.NullLit):
+            from ..expr.scalar import null_sentinel
+
+            return null_sentinel(cdesc.dtype)
         if cdesc.typ == ColType.STRING and isinstance(
             e, (ast.NumberLit, ast.BoolLit)
         ):
@@ -719,9 +757,9 @@ class Coordinator:
         desc = _lower(
             gid, rel, env, src_gids, index_key=(), as_of=0, mono_ids=self._mono_ids()
         )
-        df = Dataflow(desc)
         as_of = self.oracle.read_ts()
         snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
+        df = self._make_dataflow(desc, snaps)
         results = df.step(as_of, snaps)
         out = results.get(gid)
         if out is not None and out[0] is not None:
@@ -982,11 +1020,12 @@ class Coordinator:
                 if err is not None:
                     raise RuntimeError(f"query error: {err}")
                 out.append(tuple(cols[i] for i in mfp.projection))
-            return sorted(out)
+            return sorted(out, key=_null_safe_row_key)
         if isinstance(rel, mir.MirGet):
             for mv_gid, df, _src in self.dataflows:
                 if mv_gid == rel.id:
-                    return df.peek(f"idx_{mv_gid}", at=as_of)
+                    rows = df.peek(f"idx_{mv_gid}", at=as_of)
+                    return self._sentinels_to_none(rows, rel.id)
             st = self.storage.get(rel.id)
             if st is not None:
                 out: dict = {}
@@ -998,15 +1037,65 @@ class Coordinator:
                     out[data] = out.get(data, 0) + d
                 from ..dataflow.runtime import materialize_counts
 
-                return materialize_counts(out, rel.id)
+                return self._sentinels_to_none(
+                    materialize_counts(out, rel.id), rel.id
+                )
         return None
+
+    def _sentinels_to_none(self, rows: list, gid: str) -> list:
+        """Encoded host rows → None-space NULLs, by storage column dtype.
+
+        Host-side expression evaluation (fast-path MFPs, UPDATE assignments)
+        cannot tell a -128 INT64 from a NULL BOOL by value alone; the storage
+        dtype disambiguates. Idempotent for rows already holding None."""
+        st = self.storage.get(gid)
+        if st is None:
+            return rows
+        import numpy as _np
+
+        from ..expr.scalar import NULL_I8, NULL_I32, NULL_I64
+
+        sentinels = []
+        for dt in st.dtypes:
+            dt = _np.dtype(dt)
+            if dt == _np.int8:
+                sentinels.append(int(NULL_I8))
+            elif dt == _np.int32:
+                sentinels.append(int(NULL_I32))
+            elif dt in (_np.dtype(_np.int64), _np.dtype(_np.uint64)):
+                sentinels.append(int(NULL_I64))
+            else:
+                sentinels.append(None)  # floats: NaN checked directly
+        out = []
+        for r in rows:
+            out.append(
+                tuple(
+                    None
+                    if v is None
+                    or (isinstance(v, float) and v != v)
+                    or (sentinels[i] is not None and int(v) == sentinels[i])
+                    else v
+                    for i, v in enumerate(r)
+                )
+            )
+        return out
 
     def _finish(self, rows: list, pq: PlannedQuery) -> list:
         f = pq.finishing
         decoded = [self._decode_row(r, pq) for r in rows]
         if f.order_by:
-            for col, desc_ in reversed(f.order_by):
-                decoded.sort(key=lambda r: r[col], reverse=desc_)
+            nulls = f.nulls_last or tuple(not d for _c, d in f.order_by)
+            for (col, desc_), nl in reversed(list(zip(f.order_by, nulls))):
+                # k0 places NULLs per the requested side under the reverse
+                # flag (pg default: NULLS LAST ascending, FIRST descending)
+                null_hi = nl != desc_
+                decoded.sort(
+                    key=lambda r: (
+                        (r[col] is None) if null_hi else (r[col] is not None),
+                        r[col] if r[col] is not None else 0,
+                    ),
+                    reverse=desc_,
+                )
         if f.offset:
             decoded = decoded[f.offset :]
         if f.limit is not None:
@@ -1014,10 +1103,14 @@ class Coordinator:
         return decoded
 
     def _decode_row(self, row: tuple, pq: PlannedQuery) -> tuple:
+        from ..expr.scalar import is_null_value
+
         out = []
         for v, c in zip(row, pq.scope.cols):
             t = c.typ
-            if t.col == ColType.STRING:
+            if is_null_value(v, t.col):
+                out.append(None)
+            elif t.col == ColType.STRING:
                 out.append(self.catalog.dict.decode(int(v)))
             elif t.col == ColType.NUMERIC and t.scale:
                 out.append(v / (10**t.scale))
@@ -1132,17 +1225,31 @@ def explain_lir(e, indent: int = 0) -> str:
     return "\n".join(lines)
 
 
+def _null_safe_row_key(row: tuple):
+    """Deterministic sort key for host-path rows that may hold None."""
+    return tuple((v is None, 0 if v is None else v) for v in row)
+
+
 def _eval_scalar_on_row(e, row: list):
     """Host interpreter for a planned ScalarExpr over one encoded row
-    (UPDATE assignment evaluation; mirrors eval_expr's semantics)."""
+    (UPDATE assignments, fast-path peek MFPs; mirrors eval_expr3's
+    three-valued semantics with Python None as NULL)."""
     from ..expr import scalar as s
+    from ..expr.scalar import is_null_value
 
     if isinstance(e, s.Column):
-        return row[e.index]
+        v = row[e.index]
+        return None if is_null_value(v) else v
     if isinstance(e, s.Literal):
         return e.value
     if isinstance(e, s.CallUnary):
         v = _eval_scalar_on_row(e.expr, row)
+        if e.func == "is_null":
+            return v is None
+        if e.func == "is_not_null":
+            return v is not None
+        if v is None:
+            return None
         if e.func in ("extract_year", "extract_month", "extract_day"):
             from ..expr.scalar import civil_from_days_int
 
@@ -1162,6 +1269,20 @@ def _eval_scalar_on_row(e, row: list):
     if isinstance(e, s.CallBinary):
         l = _eval_scalar_on_row(e.left, row)
         r = _eval_scalar_on_row(e.right, row)
+        if e.func == "and":  # Kleene: FALSE dominates NULL
+            if l is False or r is False or l == 0 and l is not None or r == 0 and r is not None:
+                return False
+            if l is None or r is None:
+                return None
+            return bool(l) and bool(r)
+        if e.func == "or":  # Kleene: TRUE dominates NULL
+            if (l is not None and bool(l)) or (r is not None and bool(r)):
+                return True
+            if l is None or r is None:
+                return None
+            return False
+        if l is None or r is None:
+            return None
         if e.func in ("div", "floordiv"):
             if r == 0:
                 raise PlanError("division by zero")
@@ -1178,23 +1299,41 @@ def _eval_scalar_on_row(e, row: list):
             "lte": lambda: l <= r,
             "gt": lambda: l > r,
             "gte": lambda: l >= r,
-            "and": lambda: l and r,
-            "or": lambda: l or r,
             "min": lambda: min(l, r),
             "max": lambda: max(l, r),
         }[e.func]()
     if isinstance(e, s.CallVariadic):
         vs = [_eval_scalar_on_row(x, row) for x in e.exprs]
         if e.func == "if":
-            return vs[1] if vs[0] else vs[2]
+            return vs[1] if (vs[0] is not None and vs[0]) else vs[2]
         if e.func == "and":
-            return all(vs)
+            if any(v is not None and not v for v in vs):
+                return False
+            if any(v is None for v in vs):
+                return None
+            return True
         if e.func == "or":
-            return any(vs)
+            if any(v is not None and v for v in vs):
+                return True
+            if any(v is None for v in vs):
+                return None
+            return False
+        if e.func == "coalesce":
+            for v in vs:
+                if v is not None:
+                    return v
+            return None
+        if e.func == "nullif":
+            a, b = vs
+            if a is not None and b is not None and a == b:
+                return None
+            return a
         if e.func == "greatest":
-            return max(vs)
+            nn = [v for v in vs if v is not None]
+            return max(nn) if nn else None
         if e.func == "least":
-            return min(vs)
+            nn = [v for v in vs if v is not None]
+            return min(nn) if nn else None
     raise PlanError(f"cannot evaluate {e!r} host-side")
 
 

@@ -96,6 +96,14 @@ LOG_FILTER = Config(
     "log_filter", "off", "tracing emission level: off | info | debug "
     "(the ALTER SYSTEM SET log_filter analogue, doc/developer/tracing.md)"
 )
+FUSED_RENDER = Config(
+    "enable_fused_render",
+    False,
+    "render installed materialized views as ONE jitted XLA program per tick "
+    "(dataflow/fused.py) instead of host-orchestrated operators; plans the "
+    "fused compiler can't express fall back automatically (the "
+    "ENABLE_MZ_JOIN_CORE-style rendering toggle for the fused path)",
+)
 
 ALL_CONFIGS = [
     ENABLE_DELTA_JOIN,
@@ -106,6 +114,7 @@ ALL_CONFIGS = [
     LOG_FILTER,
     MEMORY_LIMIT_MB,
     COMPACTION_WINDOW,
+    FUSED_RENDER,
 ]
 
 

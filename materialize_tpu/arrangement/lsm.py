@@ -77,10 +77,15 @@ def _false_like(b) -> jnp.ndarray:
     return b.count() < 0
 
 
-def lsm_insert(lsm: LsmBatches, delta: UpdateBatch, tick, ratio: int = 4):
+def lsm_insert(lsm: LsmBatches, delta: UpdateBatch, tick, ratio: int = 4, since=None):
     """Insert a keyed, consolidated delta; run the tick's scheduled merges.
 
     `tick` is a traced i32/i64 scalar. Returns (lsm', overflow).
+
+    With `since` (traced u64), merges first advance times to the compaction
+    frontier so +/- pairs at different (now-bygone) times cancel — the
+    differential trace-compaction rule that keeps long-running arrangements
+    proportional to their live contents, not their history.
     """
     levels = list(lsm.levels)
     overflow = jnp.asarray(False)
@@ -94,7 +99,12 @@ def lsm_insert(lsm: LsmBatches, delta: UpdateBatch, tick, ratio: int = 4):
 
         def merge(args, i=i):
             lo, hi = args
-            merged = consolidate(UpdateBatch.concat(hi, lo))
+            cat = UpdateBatch.concat(hi, lo)
+            if since is not None:
+                from ..ops.consolidate import advance_times
+
+                cat = advance_times(cat, since)
+            merged = consolidate(cat)
             of = merged.count() > hi.cap
             return _empty_like(lo), merged.with_capacity(hi.cap), of
 

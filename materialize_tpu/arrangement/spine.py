@@ -133,7 +133,9 @@ class Arrangement:
         rows = []
         n = len(out["times"])
         for i in range(n):
-            data = tuple(out[f"c{j}"][i].item() for j in range(ncols))
+            data = tuple(
+                _host_value(out[f"c{j}"][i]) for j in range(ncols)
+            )
             rows.append((data, int(out["times"][i]), int(out["diffs"][i])))
         return rows
 
@@ -142,3 +144,13 @@ class Arrangement:
 
     def total_cap(self) -> int:
         return sum(b.cap for b in self.batches)
+
+
+def _host_value(v):
+    """Python value of one host scalar; float NaN (the float NULL sentinel)
+    becomes None so NULL rows accumulate/compare correctly in host dicts
+    (two NaN objects are never equal in Python)."""
+    x = v.item()
+    if isinstance(x, float) and x != x:
+        return None
+    return x

@@ -418,12 +418,13 @@ class MonotonicTopKNode(Node):
         vdt = tuple(v.dtype for v in keyed.vals)
         old_kept = gather_groups(probes, self.out_arr.batches, tick, vdt)
         cand = consolidate(UpdateBatch.concat(old_kept, keyed))
-        new_kept = topk_select(cand, self.plan.order_by, self.keep, 0, tick)
+        nl = self.plan.nulls_last
+        new_kept = topk_select(cand, self.plan.order_by, self.keep, 0, tick, nl)
         new_window = topk_select(
-            cand, self.plan.order_by, self.plan.limit, self.plan.offset, tick
+            cand, self.plan.order_by, self.plan.limit, self.plan.offset, tick, nl
         )
         old_window = topk_select(
-            old_kept, self.plan.order_by, self.plan.limit, self.plan.offset, tick
+            old_kept, self.plan.order_by, self.plan.limit, self.plan.offset, tick, nl
         )
         out = consolidate(UpdateBatch.concat(new_window, negate(old_window)))
         state_delta = consolidate(
@@ -637,7 +638,8 @@ def materialize_counts(acc: dict, label: str) -> list[tuple]:
     mean upstream inconsistency and error (the reference surfaces these as
     'Invalid data in source, saw retractions' rather than masking)."""
     rows: list[tuple] = []
-    for data, cnt in sorted(acc.items()):
+    key = lambda kv: tuple((v is None, 0 if v is None else v) for v in kv[0])
+    for data, cnt in sorted(acc.items(), key=key):
         if cnt < 0:
             raise RuntimeError(
                 f"peek {label}: negative multiplicity {cnt} for {data}"
@@ -942,18 +944,20 @@ def _expr_dtype(expr, col_dtypes):
             return np.dtype(np.int32)
         if expr.func in ("cast_float", "sqrt"):
             return np.dtype(np.float32)
-        if expr.func in ("not", "is_true"):
+        if expr.func == "is_true":
             return np.dtype(np.bool_)
+        if expr.func in ("not", "is_null", "is_not_null"):
+            return np.dtype(np.int8)  # stored truth values (nullable bool)
         return _expr_dtype(expr.expr, col_dtypes)
     if isinstance(expr, s.CallBinary):
-        if expr.func in ("eq", "ne", "lt", "lte", "gt", "gte"):
-            return np.dtype(np.bool_)
+        if expr.func in ("eq", "ne", "lt", "lte", "gt", "gte", "and", "or"):
+            return np.dtype(np.int8)
         lt_ = _expr_dtype(expr.left, col_dtypes)
         rt = _expr_dtype(expr.right, col_dtypes)
         return np.promote_types(lt_, rt)
     if isinstance(expr, s.CallVariadic):
         if expr.func in ("and", "or"):
-            return np.dtype(np.bool_)
+            return np.dtype(np.int8)
         if expr.func == "if":
             return np.promote_types(
                 _expr_dtype(expr.exprs[1], col_dtypes),

@@ -129,21 +129,25 @@ class MapFilterProject:
         errs has vals=(err_code,) and inherits time/diff from the failing rows;
         rows without error are inert there (diff 0).
         """
+        from .scalar import _truth, eval_expr3, force_sentinel
+
         cols = list(batch.vals)
         n = batch.cap
         map_err = jnp.zeros((n,), dtype=jnp.int32)
         for e in self.map_exprs:
-            v, ev = eval_expr(e, cols, n)
+            v, nv, ev = eval_expr3(e, cols, n)
             map_err = jnp.maximum(map_err, ev)
-            cols.append(v)
+            cols.append(force_sentinel(v, nv))
 
         keep = jnp.ones((n,), dtype=jnp.bool_)
         pred_err = jnp.zeros((n,), dtype=jnp.int32)
         for p in self.predicates:
-            v, ev = eval_expr(p, cols, n)
+            v, nv, ev = eval_expr3(p, cols, n)
             pred_err = jnp.maximum(pred_err, ev)
-            # an erroring predicate doesn't filter (the row errors instead)
-            keep = keep & (v.astype(jnp.bool_) | (ev != 0))
+            # WHERE keeps rows whose predicate is TRUE: NULL filters like
+            # FALSE (three-valued logic); an erroring predicate doesn't
+            # filter (the row errors instead)
+            keep = keep & ((_truth(v) & ~nv) | (ev != 0))
 
         # Guard semantics: a row only errors if it would otherwise survive the
         # filters — `WHERE b <> 0` really does guard `SELECT a / b`

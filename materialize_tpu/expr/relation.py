@@ -52,15 +52,20 @@ class MirProject:
 class MirJoin:
     """N-way join with equivalence classes of column references.
 
-    equivalences: tuple of tuples of (input_idx, col_idx) — all members of a
-    class must be equal. Global column order = concatenation of input columns
-    (the reference's flat join column space, relation.rs Join docs).
+    equivalences: tuple of tuples of GLOBAL column indices — all members of
+    a class must be equal. Global column order = concatenation of input
+    columns (the reference's flat join column space, relation.rs Join docs).
     """
 
     inputs: tuple
     equivalences: tuple
     # filled by the JoinImplementation transform (join_implementation.rs):
     implementation: Optional[Any] = None  # "linear" | "delta" plan object
+    # IS NOT DISTINCT FROM semantics: NULL keys match NULL keys. Used by
+    # planner-internal joins (outer-join compensation semijoins) where the
+    # in-band sentinel's native equality is exactly what's wanted; lowering
+    # skips the IS NOT NULL key guards for these.
+    null_safe: bool = False
 
 
 @dataclass(frozen=True)
@@ -86,6 +91,8 @@ class MirTopK:
     order_by: tuple  # ((col, desc), ...)
     limit: Optional[int]
     offset: int = 0
+    # per-order-col NULL placement; None = pg default (last asc, first desc)
+    nulls_last: Optional[tuple] = None
 
 
 @dataclass(frozen=True)

@@ -1,4 +1,4 @@
-"""Scalar expressions evaluated columnwise on device.
+"""Scalar expressions evaluated columnwise on device, with SQL NULLs.
 
 The TPU analogue of the reference's `MirScalarExpr`
 (src/expr/src/scalar.rs:69) and its Unary/Binary/Variadic function enums
@@ -7,6 +7,20 @@ JAX computation over column arrays, vectorized across the batch. Runtime
 errors (division by zero, …) do not trap: they produce a per-row error code
 that the MFP routes into the dataflow's error stream, mirroring the
 reference's oks/errs twin collections (src/compute/src/render.rs:30-101).
+
+**NULL representation** (the `Datum::Null` analogue, src/repr/src/row.rs:1071,
+re-designed columnar): NULL is IN-BAND — a per-dtype sentinel value stored in
+the column itself (INT64_MIN for 64-bit ints, INT32_MIN / -128 for narrower,
+NaN for floats). Evaluation derives a boolean null mask from the stored
+values at each Column reference, threads three-valued logic through the tree
+as (value, null, err) triples, and re-materializes the sentinel at operator
+output boundaries. Because the sentinel IS the stored value, hashing,
+sorting, consolidation, grouping and DISTINCT treat NULL as an ordinary
+value (SQL's NULLs-group-together semantics) with zero kernel changes; only
+equality JOINs need planner-inserted IS NOT NULL guards (SQL's
+NULL-never-matches semantics). Trade-off: the sentinel value itself cannot
+be stored (INT64_MIN as data reads back as NULL) — documented, like the
+engine's other fixed-width compromises.
 """
 
 from __future__ import annotations
@@ -17,6 +31,68 @@ from typing import Any
 
 import jax.numpy as jnp
 import numpy as np
+
+NULL_I64 = np.int64(np.iinfo(np.int64).min)
+NULL_I32 = np.int32(np.iinfo(np.int32).min)
+NULL_I8 = np.int8(-128)
+
+
+def null_sentinel(dtype) -> Any:
+    """The in-band NULL value for a storage dtype."""
+    dt = np.dtype(dtype)
+    if dt == np.int64 or dt == np.uint64:
+        return NULL_I64
+    if dt == np.int32:
+        return NULL_I32
+    if dt == np.int8 or dt == np.bool_:
+        return NULL_I8
+    if np.issubdtype(dt, np.floating):
+        return dt.type(np.nan)
+    raise TypeError(f"no null sentinel for {dt}")
+
+
+def derived_null(col: jnp.ndarray) -> jnp.ndarray:
+    """Null mask derived from a stored column's sentinel values."""
+    if jnp.issubdtype(col.dtype, jnp.floating):
+        return jnp.isnan(col)
+    if col.dtype == jnp.bool_:
+        return jnp.zeros(col.shape, dtype=jnp.bool_)
+    return col == jnp.asarray(null_sentinel(col.dtype), col.dtype)
+
+
+def is_null_value(v, coltype=None) -> bool:
+    """Host-side: is a decoded storage scalar the NULL sentinel?
+
+    `coltype` (a repr.types.ColType) picks the right sentinel width — -128 is
+    NULL only for BOOL columns, INT32_MIN only for INT32, etc. Without it,
+    only the unambiguous sentinels (None, NaN, INT64_MIN) are recognized.
+    """
+    if v is None:
+        return True
+    if isinstance(v, float) and v != v:  # NaN
+        return True
+    if isinstance(v, (int, np.integer)):
+        iv = int(v)
+        if coltype is None:
+            return iv == int(NULL_I64)
+        name = getattr(coltype, "name", str(coltype))
+        if name == "BOOL":
+            return iv == int(NULL_I8)
+        if name == "INT32":
+            return iv == int(NULL_I32)
+        return iv == int(NULL_I64)
+    return False
+
+
+def force_sentinel(col: jnp.ndarray, null: jnp.ndarray) -> jnp.ndarray:
+    """Write the dtype sentinel wherever `null` — the output-boundary
+    materialization that keeps NULL canonical in storage."""
+    if col.dtype == jnp.bool_:
+        # bool arrays cannot carry a sentinel; nullable booleans are stored
+        # as int8 by the planner (ColType.BOOL), so a bool array here means
+        # an eval-internal predicate that is about to be consumed, not stored
+        return col
+    return jnp.where(null, jnp.asarray(null_sentinel(col.dtype), col.dtype), col)
 
 
 class EvalErr(enum.IntEnum):
@@ -63,51 +139,101 @@ ScalarExpr = Any  # Column | Literal | CallUnary | CallBinary | CallVariadic
 
 
 def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
-    """Evaluate to (value_array[n], err_code_array[n] int32)."""
+    """Evaluate to (value[n], err_code[n] int32) — the storage-facing surface.
+
+    NULL rows come back with the dtype sentinel already materialized (and no
+    error), so callers that write columns need no extra handling; callers
+    that need the mask itself use `eval_expr3`.
+    """
+    v, null, err = eval_expr3(expr, cols, n)
+    return force_sentinel(v, null), err
+
+
+def _truth(v: jnp.ndarray) -> jnp.ndarray:
+    """Boolean view of a stored truth value (int8 {0,1} or bool)."""
+    return v.astype(jnp.bool_) if v.dtype != jnp.bool_ else v
+
+
+def _as_bool_i8(b: jnp.ndarray) -> jnp.ndarray:
+    return b.astype(jnp.int8)
+
+
+def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
+    """Three-valued evaluation: (value[n], null[n] bool, err[n] int32).
+
+    Values under a set null bit are unspecified until `force_sentinel`;
+    errors never fire on NULL rows (SQL: NULL/0 is NULL, not an error).
+    Boolean results are int8 {0,1} — ColType.BOOL's storage dtype.
+    """
     zero_err = jnp.zeros((n,), dtype=jnp.int32)
+    no_null = jnp.zeros((n,), dtype=jnp.bool_)
     if isinstance(expr, Column):
-        return cols[expr.index], zero_err
+        v = cols[expr.index]
+        return v, derived_null(v), zero_err
     if isinstance(expr, Literal):
-        v = jnp.full((n,), expr.value, dtype=np.dtype(expr.dtype))
-        return v, zero_err
+        dt = np.dtype(expr.dtype)
+        if expr.value is None:
+            return (
+                jnp.full((n,), null_sentinel(dt), dtype=dt),
+                jnp.ones((n,), dtype=jnp.bool_),
+                zero_err,
+            )
+        if dt == np.bool_:  # legacy spelling: booleans store as int8
+            return jnp.full((n,), int(bool(expr.value)), dtype=np.int8), no_null, zero_err
+        return jnp.full((n,), expr.value, dtype=dt), no_null, zero_err
     if isinstance(expr, CallUnary):
-        v, e = eval_expr(expr.expr, cols, n)
-        if expr.func == "neg":
-            return -v, e
-        if expr.func == "not":
-            return ~v, e
-        if expr.func == "abs":
-            return jnp.abs(v), e
-        if expr.func == "is_true":
-            return v.astype(jnp.bool_), e
-        if expr.func == "cast_int64":
-            return v.astype(jnp.int64), e
-        if expr.func == "cast_int32":
-            return v.astype(jnp.int32), e
-        if expr.func == "cast_float":
-            return v.astype(jnp.float32), e
-        if expr.func == "sqrt":
-            return jnp.sqrt(v.astype(jnp.float32)), e
-        if expr.func in ("extract_year", "extract_month", "extract_day"):
-            y, m, d = _civil_from_days(v)
-            return {"extract_year": y, "extract_month": m, "extract_day": d}[
-                expr.func
-            ], e
-        raise NotImplementedError(f"unary func {expr.func}")
-    if isinstance(expr, CallBinary):
-        lv, le = eval_expr(expr.left, cols, n)
-        rv, re_ = eval_expr(expr.right, cols, n)
-        err = jnp.maximum(le, re_)
         f = expr.func
+        v, null, e = eval_expr3(expr.expr, cols, n)
+        if f == "is_null":
+            return _as_bool_i8(null), no_null, zero_err
+        if f == "is_not_null":
+            return _as_bool_i8(~null), no_null, zero_err
+        e = jnp.where(null, 0, e)
+        if f == "neg":
+            return -v, null, e
+        if f == "not":
+            return _as_bool_i8(~_truth(v)), null, e
+        if f == "abs":
+            return jnp.abs(v), null, e
+        if f == "is_true":
+            # NULL is not true (WHERE-clause semantics handled by MFP's keep)
+            return _truth(v) & ~null, no_null, e
+        if f == "cast_int64":
+            return v.astype(jnp.int64), null, e
+        if f == "cast_int32":
+            return v.astype(jnp.int32), null, e
+        if f == "cast_float":
+            return v.astype(jnp.float32), null, e
+        if f == "sqrt":
+            return jnp.sqrt(v.astype(jnp.float32)), null, e
+        if f in ("extract_year", "extract_month", "extract_day"):
+            y, m, d = _civil_from_days(v)
+            return {"extract_year": y, "extract_month": m, "extract_day": d}[f], null, e
+        raise NotImplementedError(f"unary func {f}")
+    if isinstance(expr, CallBinary):
+        f = expr.func
+        lv, ln, le = eval_expr3(expr.left, cols, n)
+        rv, rn, re_ = eval_expr3(expr.right, cols, n)
+        null = ln | rn
+        err = jnp.where(null, 0, jnp.maximum(le, re_))
+        if f == "and":
+            lt, rt = _truth(lv) & ~ln, _truth(rv) & ~rn
+            lf, rf = ~_truth(lv) & ~ln, ~_truth(rv) & ~rn
+            is_false = lf | rf  # Kleene: FALSE dominates NULL
+            return _as_bool_i8(lt & rt), null & ~is_false, err
+        if f == "or":
+            lt, rt = _truth(lv) & ~ln, _truth(rv) & ~rn
+            is_true = lt | rt  # Kleene: TRUE dominates NULL
+            return _as_bool_i8(is_true), null & ~is_true, err
         if f == "add":
-            return lv + rv, err
+            return lv + rv, null, err
         if f == "sub":
-            return lv - rv, err
+            return lv - rv, null, err
         if f == "mul":
-            return lv * rv, err
+            return lv * rv, null, err
         if f in ("div", "floordiv"):
-            zero = rv == 0
-            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            zero = (rv == 0) & ~null
+            safe = jnp.where(rv == 0, jnp.ones_like(rv), rv)
             if jnp.issubdtype(jnp.result_type(lv, rv), jnp.floating):
                 out = lv / safe
             else:
@@ -116,65 +242,87 @@ def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
                 q = jnp.abs(lv) // jnp.abs(safe)
                 out = jnp.where((lv < 0) ^ (safe < 0), -q, q)
             err = jnp.where(zero, jnp.int32(EvalErr.DIVISION_BY_ZERO), err)
-            return out, err
+            return out, null, err
         if f == "mod":
-            zero = rv == 0
-            safe = jnp.where(zero, jnp.ones_like(rv), rv)
+            zero = (rv == 0) & ~null
+            safe = jnp.where(rv == 0, jnp.ones_like(rv), rv)
             out = lv - safe * (
                 jnp.where((lv < 0) ^ (safe < 0), -(jnp.abs(lv) // jnp.abs(safe)), jnp.abs(lv) // jnp.abs(safe))
             )
             err = jnp.where(zero, jnp.int32(EvalErr.DIVISION_BY_ZERO), err)
-            return out, err
+            return out, null, err
         if f == "eq":
-            return lv == rv, err
+            return _as_bool_i8(lv == rv), null, err
         if f == "ne":
-            return lv != rv, err
+            return _as_bool_i8(lv != rv), null, err
         if f == "lt":
-            return lv < rv, err
+            return _as_bool_i8(lv < rv), null, err
         if f == "lte":
-            return lv <= rv, err
+            return _as_bool_i8(lv <= rv), null, err
         if f == "gt":
-            return lv > rv, err
+            return _as_bool_i8(lv > rv), null, err
         if f == "gte":
-            return lv >= rv, err
-        if f == "and":
-            return lv & rv, err
-        if f == "or":
-            return lv | rv, err
+            return _as_bool_i8(lv >= rv), null, err
         if f == "min":
-            return jnp.minimum(lv, rv), err
+            return jnp.minimum(lv, rv), null, err
         if f == "max":
-            return jnp.maximum(lv, rv), err
+            return jnp.maximum(lv, rv), null, err
         raise NotImplementedError(f"binary func {f}")
     if isinstance(expr, CallVariadic):
-        vals, errs = zip(*(eval_expr(e, cols, n) for e in expr.exprs))
+        f = expr.func
+        parts = [eval_expr3(e, cols, n) for e in expr.exprs]
+        vals = [p[0] for p in parts]
+        nulls = [p[1] for p in parts]
+        errs = [p[2] for p in parts]
+        any_null = nulls[0]
+        for m in nulls[1:]:
+            any_null = any_null | m
         err = errs[0]
         for e in errs[1:]:
             err = jnp.maximum(err, e)
-        f = expr.func
         if f == "and":
-            out = vals[0]
-            for v in vals[1:]:
-                out = out & v
-            return out, err
+            is_false = no_null
+            all_true = ~no_null
+            for v, m in zip(vals, nulls):
+                is_false = is_false | (~_truth(v) & ~m)
+                all_true = all_true & (_truth(v) & ~m)
+            err = jnp.where(any_null & ~is_false, 0, err)
+            return _as_bool_i8(all_true), any_null & ~is_false, err
         if f == "or":
-            out = vals[0]
-            for v in vals[1:]:
-                out = out | v
-            return out, err
+            is_true = no_null
+            for v, m in zip(vals, nulls):
+                is_true = is_true | (_truth(v) & ~m)
+            err = jnp.where(any_null & ~is_true, 0, err)
+            return _as_bool_i8(is_true), any_null & ~is_true, err
         if f == "if":
-            cond, then_, else_ = vals
-            return jnp.where(cond.astype(jnp.bool_), then_, else_), err
+            (cv, cn, _), (tv, tn, _), (ev, en, _) = parts
+            take = _truth(cv) & ~cn  # NULL condition selects ELSE
+            out = jnp.where(take, tv, ev)
+            return out, jnp.where(take, tn, en), err
+        if f == "coalesce":
+            out, null = vals[0], nulls[0]
+            for v, m in zip(vals[1:], nulls[1:]):
+                out = jnp.where(null, v.astype(out.dtype), out)
+                null = null & m
+            return out, null, err
+        if f == "nullif":
+            a, an = vals[0], nulls[0]
+            b, bn = vals[1], nulls[1]
+            eq = (a == b.astype(a.dtype)) & ~an & ~bn
+            return a, an | eq, err
         if f == "greatest":
-            out = vals[0]
-            for v in vals[1:]:
-                out = jnp.maximum(out, v)
-            return out, err
+            out, null = vals[0], nulls[0]
+            for v, m in zip(vals[1:], nulls[1:]):
+                # SQL greatest/least ignore NULLs; all-NULL stays NULL
+                out = jnp.where(null, v, jnp.where(m, out, jnp.maximum(out, v)))
+                null = null & m
+            return out, null, err
         if f == "least":
-            out = vals[0]
-            for v in vals[1:]:
-                out = jnp.minimum(out, v)
-            return out, err
+            out, null = vals[0], nulls[0]
+            for v, m in zip(vals[1:], nulls[1:]):
+                out = jnp.where(null, v, jnp.where(m, out, jnp.minimum(out, v)))
+                null = null & m
+            return out, null, err
         raise NotImplementedError(f"variadic func {f}")
     raise TypeError(f"not a ScalarExpr: {expr!r}")
 

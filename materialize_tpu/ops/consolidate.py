@@ -33,13 +33,30 @@ def row_equal_prev(cols) -> jnp.ndarray:
 
 @jax.jit
 def consolidate(batch: UpdateBatch) -> UpdateBatch:
-    """Canonicalize a batch: sorted, one row per (key,val,time), no zero diffs.
+    """Canonicalize a batch: hash-sorted, equal rows merged, no zero diffs.
+
+    The sort key is (key_hash, row_hash, time) — 3 fixed operands instead of
+    the full row (TPU sorts cost per operand in both runtime and compile
+    time; this is the single hottest kernel). row_hash is a u64 content hash
+    of the val columns, so duplicate rows inside one key group still land
+    adjacent and annihilate; equal-row runs are then confirmed by full-row
+    adjacent comparison, which keeps correctness under hash collisions —
+    colliding distinct rows merely stay split across entries, and every
+    consumer treats a batch as a multiset of (row, time, diff) updates
+    (operators are linear in diff), so only perfect annihilation (a capacity
+    concern, not correctness) needs adjacency.
 
     Padding rows sort last (PAD_HASH) and keep diff 0, so they fold into one
     run that is masked back out. Output has the same capacity.
     """
+    from ..repr.hashing import hash_columns
+
     cap = batch.cap
-    order = jnp.lexsort(batch.sort_cols())
+    if batch.vals:
+        row_hash = hash_columns(batch.vals)
+    else:
+        row_hash = jnp.zeros_like(batch.hashes)
+    order = jnp.lexsort((batch.times, row_hash, batch.hashes))
     b = batch.permute(order)
 
     cmp_cols = [b.hashes, *b.keys, *b.vals, b.times]
@@ -62,9 +79,9 @@ def consolidate(batch: UpdateBatch) -> UpdateBatch:
 
 
 def _cmp_view(c: jnp.ndarray) -> jnp.ndarray:
-    if c.dtype == jnp.bool_:
-        return c.astype(jnp.int32)
-    return c
+    from ..repr.hashing import value_view
+
+    return value_view(c)
 
 
 @jax.jit

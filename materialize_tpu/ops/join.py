@@ -68,10 +68,14 @@ def join_materialize(
     ai = jnp.clip(ai, 0, arr.cap - 1)
     valid = j < total
 
-    # true key equality (collision guard)
+    # true key equality (collision guard); canonical views so float NULL
+    # sentinels (NaN) compare equal and -0.0 == 0.0
+    from ..repr.hashing import value_view
+
     eq = jnp.ones((out_cap,), dtype=jnp.bool_)
     for pk, ak in zip(probe.keys, arr.keys):
-        eq = eq & (pk[pi] == ak[ai])
+        pv, av = value_view(pk), value_view(ak)
+        eq = eq & (pv[pi] == av[ai])
 
     diffs = jnp.where(valid & eq, probe.diffs[pi] * arr.diffs[ai], 0)
     times = jnp.maximum(probe.times[pi], arr.times[ai])

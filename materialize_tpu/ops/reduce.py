@@ -166,16 +166,29 @@ def _contributions(delta: UpdateBatch, key_cols: tuple[int, ...], aggs):
         hashes = jnp.where(delta.live, hash_columns(keys), PAD_HASH)
     else:
         hashes = jnp.where(delta.live, jnp.zeros_like(delta.hashes), PAD_HASH)
+    from ..expr.scalar import Literal, eval_expr3
+
     err = jnp.zeros((n,), dtype=jnp.int32)
     accums = []
     for agg in aggs:
         if agg.func == "count":
-            accums.append(delta.diffs.astype(np.dtype(agg.accum_dtype)))
+            dt = np.dtype(agg.accum_dtype)
+            if isinstance(agg.expr, Literal) and agg.expr.value is not None:
+                # count(*): every row counts
+                accums.append(delta.diffs.astype(dt))
+            else:
+                # count(x): NULL inputs don't count (SQL aggregate rule)
+                v, nv, ev = eval_expr3(agg.expr, cols, n)
+                err = jnp.maximum(err, ev)
+                accums.append(jnp.where(nv, 0, delta.diffs).astype(dt))
         elif agg.func == "sum":
-            v, ev = eval_expr(agg.expr, cols, n)
+            v, nv, ev = eval_expr3(agg.expr, cols, n)
             err = jnp.maximum(err, ev)
             dt = np.dtype(agg.accum_dtype)
-            accums.append(v.astype(dt) * delta.diffs.astype(dt))
+            contrib = v.astype(dt) * delta.diffs.astype(dt)
+            # NULL inputs contribute nothing (SQL sum ignores NULLs; an
+            # all-NULL group reads 0 until typed NULL aggregates land)
+            accums.append(jnp.where(nv, jnp.zeros_like(contrib), contrib))
         else:
             raise NotImplementedError(f"accumulable agg {agg.func}")
     err = jnp.where(delta.live, err, 0)
@@ -205,11 +218,14 @@ def lookup_accums(state: AccumState, probe: AccumState):
     hi = jnp.searchsorted(state.hashes, probe.hashes, side="right")
     found = jnp.zeros(probe.hashes.shape, dtype=jnp.bool_)
     idx = jnp.zeros(probe.hashes.shape, dtype=lo.dtype)
+    from ..repr.hashing import value_view
+
     for off in range(_MAX_HASH_COLLISIONS):
         cand = jnp.clip(lo + off, 0, state.cap - 1)
         eq = (lo + off) < hi
         for pk, sk in zip(probe.keys, state.keys):
-            eq = eq & (pk == sk[cand])
+            pv, sv = value_view(pk), value_view(sk)
+            eq = eq & (pv == sv[cand])
         eq = eq & probe.live
         take = eq & ~found
         idx = jnp.where(take, cand, idx)

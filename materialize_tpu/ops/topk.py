@@ -20,6 +20,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
 from ..repr.hashing import PAD_HASH
@@ -32,12 +33,17 @@ class TopKPlan:
 
     order_by: tuple of (val column index, descending) pairs.
     limit None = no limit (offset-only); k is required for the kernel path.
+    nulls_last: per-order-column NULL placement; None = the pg default
+    (NULLS LAST ascending, NULLS FIRST descending). MIN/MAX lowering sets
+    all-True so NULL inputs never win a group (SQL aggregates ignore NULLs)
+    while all-NULL groups still yield a NULL row.
     """
 
     group_cols: tuple[int, ...]
     order_by: tuple[tuple[int, bool], ...]
     limit: int | None
     offset: int = 0
+    nulls_last: tuple[bool, ...] | None = None
 
 
 @jax.jit
@@ -96,9 +102,12 @@ def _gather_materialize(probes: UpdateBatch, arr: UpdateBatch, out_cap: int) -> 
     prev = jnp.where(pi > 0, cum[pi - 1], 0)
     ai = jnp.clip(lo[pi] + (j - prev), 0, arr.cap - 1)
     valid = j < total
+    from ..repr.hashing import value_view
+
     eq = jnp.ones((out_cap,), dtype=jnp.bool_)
     for pk, ak in zip(probes.keys, arr.keys):
-        eq = eq & (pk[pi] == ak[ai])
+        pv, av = value_view(pk), value_view(ak)
+        eq = eq & (pv[pi] == av[ai])
     ok = valid & eq & (arr.diffs[ai] != 0)
     return UpdateBatch(
         hashes=jnp.where(ok, arr.hashes[ai], PAD_HASH),
@@ -127,24 +136,29 @@ def gather_groups(
     return consolidate(advance_times(acc, as_of))
 
 
-@partial(jax.jit, static_argnames=("order_by", "limit", "offset"))
-def topk_select(rows: UpdateBatch, order_by, limit, offset: int, time) -> UpdateBatch:
+@partial(jax.jit, static_argnames=("order_by", "limit", "offset", "nulls_last"))
+def topk_select(
+    rows: UpdateBatch, order_by, limit, offset: int, time, nulls_last=None
+) -> UpdateBatch:
     """Window [offset, offset+limit) of each group's multiset, by order_by.
 
     rows: consolidated group contents (keys = group cols). Multiplicities are
     windowed with a segmented running sum — a row with diff 3 straddling the
-    boundary keeps the in-window portion of its diff.
+    boundary keeps the in-window portion of its diff. `nulls_last` per order
+    column; None = pg default (last when ascending, first when descending).
     """
     n = rows.cap
     d = jnp.maximum(rows.diffs, 0) * rows.live  # negative multiplicities ignored
+    if nulls_last is None:
+        nulls_last = tuple(not desc for _c, desc in order_by)
     sort_cols: list = []
     # tie-break: remaining val columns ascending for determinism
     used = [c for c, _ in order_by]
     for i in reversed(range(len(rows.vals))):
         if i not in used:
-            sort_cols.append(_ord_view(rows.vals[i], False))
-    for c, desc in reversed(order_by):
-        sort_cols.append(_ord_view(rows.vals[c], desc))
+            sort_cols.append(_ord_view(rows.vals[i], False, True))
+    for (c, desc), nl in zip(reversed(order_by), reversed(nulls_last)):
+        sort_cols.append(_ord_view(rows.vals[c], desc, nl))
     for k in reversed(rows.keys):
         sort_cols.append(k)
     sort_cols.append(rows.hashes)
@@ -174,16 +188,29 @@ def topk_select(rows: UpdateBatch, order_by, limit, offset: int, time) -> Update
     )
 
 
-def _ord_view(col: jnp.ndarray, desc: bool) -> jnp.ndarray:
-    c = col.astype(jnp.int32) if col.dtype == jnp.bool_ else col
-    if not desc:
-        return c
+def _ord_view(col: jnp.ndarray, desc: bool, nulls_last: bool) -> jnp.ndarray:
+    """Sortable view honoring direction and NULL placement.
+
+    NULL sentinels (NaN / INT_MIN / -128) are mapped to the view's extreme so
+    they land where `nulls_last` says regardless of direction. A real value
+    equal to the extreme ties with NULL in ordering only (equality elsewhere
+    is exact) — the documented in-band-sentinel edge.
+    """
+    from ..expr.scalar import derived_null
+
+    c = col.astype(jnp.int8) if col.dtype == jnp.bool_ else col
+    null = derived_null(c)
     if jnp.issubdtype(c.dtype, jnp.floating):
-        return -c
+        view = -c if desc else c
+        ext = jnp.float32(np.inf) if nulls_last else jnp.float32(-np.inf)
+        return jnp.where(null, ext, view)
     # Bitwise NOT reverses the total order for both signed (two's complement:
     # ~x = -x-1, monotone decreasing, no INT_MIN overflow) and unsigned ints
     # (negation would wrap 0 to 0 and keep it minimal).
-    return ~c
+    view = ~c if desc else c
+    info = jnp.iinfo(c.dtype)
+    ext = jnp.asarray(info.max if nulls_last else info.min, c.dtype)
+    return jnp.where(null, ext, view)
 
 
 @jax.jit
@@ -207,7 +234,11 @@ def topk_step(
     old_rows = gather_groups(probes, arrangement.batches, time, vdt)
     arrangement.insert(delta_keyed, already_keyed=True)
     new_rows = gather_groups(probes, arrangement.batches, time, vdt)
-    old_top = topk_select(old_rows, plan.order_by, plan.limit, plan.offset, time)
-    new_top = topk_select(new_rows, plan.order_by, plan.limit, plan.offset, time)
+    old_top = topk_select(
+        old_rows, plan.order_by, plan.limit, plan.offset, time, plan.nulls_last
+    )
+    new_top = topk_select(
+        new_rows, plan.order_by, plan.limit, plan.offset, time, plan.nulls_last
+    )
     out = UpdateBatch.concat(new_top, negate(old_top))
     return consolidate(out)

@@ -138,17 +138,6 @@ class UpdateBatch:
     def count(self) -> jnp.ndarray:
         return jnp.sum(self.live.astype(jnp.int32))
 
-    def sort_cols(self) -> list:
-        """Columns for lexsort in canonical order: hash, keys…, vals…, time.
-
-        jnp.lexsort treats the LAST element as primary.
-        """
-        cols: list = [self.times]
-        cols.extend(_sortable(v) for v in reversed(self.vals))
-        cols.extend(_sortable(k) for k in reversed(self.keys))
-        cols.append(self.hashes)
-        return cols
-
     def to_host(self) -> dict:
         """Trimmed host copy: only live rows, in canonical order.
 
@@ -175,17 +164,15 @@ class UpdateBatch:
         }
 
     def to_rows(self) -> list[tuple]:
-        """Host rows as (val-cols tuple, time, diff) triples, canonically sorted."""
+        """Host rows as (val-cols tuple, time, diff) triples, canonically sorted.
+
+        Float NaN (the float NULL sentinel) maps to None — host dict/compare
+        semantics need NULL values that equal themselves."""
+        from ..arrangement.spine import _host_value
+
         h = self.to_host()
         out = []
         for i in range(len(h["times"])):
-            data = tuple(c[i].item() for c in h["vals"])
+            data = tuple(_host_value(c[i]) for c in h["vals"])
             out.append((data, int(h["times"][i]), int(h["diffs"][i])))
         return out
-
-
-def _sortable(col: jnp.ndarray) -> jnp.ndarray:
-    """A total-order sortable view of a column (bools widen, floats as-is)."""
-    if col.dtype == jnp.bool_:
-        return col.astype(jnp.int32)
-    return col

@@ -32,13 +32,27 @@ def splitmix64(x: jnp.ndarray) -> jnp.ndarray:
 
 def _col_to_u64(col: jnp.ndarray) -> jnp.ndarray:
     """Canonical u64 view of one column for hashing."""
+    return value_view(col).astype(jnp.uint64)
+
+
+def value_view(col: jnp.ndarray) -> jnp.ndarray:
+    """Total-order, equality-exact integer view of a column.
+
+    The single canonicalization every value-identity kernel shares (hashing,
+    consolidate runs, join/reduce/topk key equality): floats become u32 bit
+    patterns with -0.0 folded into 0.0 and ALL NaNs folded to one canonical
+    pattern — NaN is the engine's float NULL sentinel, and NULL must equal
+    NULL for grouping/consolidation (IEEE NaN != NaN would make float-NULL
+    rows unmergeable and unretractable).
+    """
     if col.dtype == jnp.bool_:
-        return col.astype(jnp.uint64)
+        return col.astype(jnp.int8)
     if jnp.issubdtype(col.dtype, jnp.floating):
         f = col.astype(jnp.float32)
         f = jnp.where(f == 0.0, jnp.float32(0.0), f)  # -0.0 == 0.0
-        return jax_bitcast_u32(f).astype(jnp.uint64)
-    return col.astype(jnp.uint64)
+        f = jnp.where(jnp.isnan(f), jnp.float32(np.nan), f)  # canonical NaN
+        return jax_bitcast_u32(f)
+    return col
 
 
 def jax_bitcast_u32(f: jnp.ndarray) -> jnp.ndarray:

@@ -43,7 +43,9 @@ _DTYPES = {
     # float32 on device: TPU has no f64; SQL doubles round-trip through f32
     # until a software-extended-precision kernel lands.
     ColType.FLOAT64: np.dtype(np.float32),
-    ColType.BOOL: np.dtype(np.bool_),
+    # int8 {0,1} with -128 = NULL: bool arrays can't carry an in-band null
+    # sentinel, so stored truth values are bytes (expr/scalar.py NULL design)
+    ColType.BOOL: np.dtype(np.int8),
     ColType.STRING: np.dtype(np.int64),
     ColType.TIMESTAMP: np.dtype(np.int64),
     ColType.NUMERIC: np.dtype(np.int64),

@@ -157,6 +157,7 @@ class SelectItem:
 class OrderByItem:
     expr: Any
     desc: bool = False
+    nulls_last: Any = None  # None = dialect default (pg: last asc, first desc)
 
 
 @dataclass(frozen=True)

@@ -107,16 +107,45 @@ class Lowerer:
         if isinstance(e, mir.MirJoin):
             impl = e.implementation or plan_join_implementation(e)
             inputs = tuple(self.lower(i) for i in e.inputs)
+            # SQL equality never matches NULLs, but the in-band sentinel
+            # representation would (sentinel == sentinel); guard every
+            # equivalence column with IS NOT NULL in the join closure
+            # (the reference's join planning likewise hoists non-null
+            # constraints from equivalences, lowering.rs)
+            guard_cols = (
+                []
+                if e.null_safe
+                else sorted({g for cls in e.equivalences for g in cls})
+            )
+
+            def res_eq(a, c):
+                if not e.null_safe:
+                    return CallBinary("eq", Column(a), Column(c))
+                # IS NOT DISTINCT FROM: NULL matches NULL in null-safe joins
+                from ..expr.scalar import CallVariadic
+
+                return CallVariadic(
+                    "or",
+                    (
+                        CallBinary("eq", Column(a), Column(c)),
+                        CallBinary(
+                            "and",
+                            CallUnary("is_null", Column(a)),
+                            CallUnary("is_null", Column(c)),
+                        ),
+                    ),
+                )
+
+            preds = tuple(
+                CallUnary("is_not_null", Column(c)) for c in guard_cols
+            ) + tuple(
+                res_eq(a, c) for a, c in impl.residual_equalities
+            )
             closure = None
-            if impl.residual_equalities:
+            if preds:
                 total = sum(mir.arity(i) for i in e.inputs)
                 b = MfpBuilder(total)
-                b.add_predicates(
-                    tuple(
-                        CallBinary("eq", Column(a), Column(c))
-                        for a, c in impl.residual_equalities
-                    )
-                )
+                b.add_predicates(preds)
                 closure = b.finish()
             return lir.Join(inputs=inputs, plan=impl.lir_plan, closure=closure)
         if isinstance(e, mir.MirReduce):
@@ -131,6 +160,7 @@ class Lowerer:
                     order_by=tuple(e.order_by),
                     limit=e.limit,
                     offset=e.offset,
+                    nulls_last=e.nulls_last,
                 ),
                 monotonic=is_monotonic(e.input, self.mono_ids),
             )
@@ -176,29 +206,29 @@ class Lowerer:
         result = self._lower_reduce_inner(e)
         if e.group_key or not e.aggregates:
             return result
-        if not all(a.func == "count" for a in e.aggregates):
-            # sum/min/max/avg over empty input are NULL in SQL; until NULL
-            # semantics land there is no representable default (0 would
-            # fabricate an out-of-domain value, and avg's sum/count division
-            # would error). Documented gap: no row.
-            return result
         return self._with_default_row(result, e)
 
     def _with_default_row(self, result, e: mir.MirReduce):
-        """Global (no GROUP BY) COUNT returns one default row (0) over empty
-        input. The reference's reduce lowering unions a default row minus an
-        existence marker (lowering.rs empty-key pattern):
+        """Global (no GROUP BY) aggregates return one default row over empty
+        input: count → 0, sum accumulators → 0 (the paired-count post guard
+        turns them into NULL), min/max → the NULL sentinel directly. The
+        reference's reduce lowering unions a default row minus an existence
+        marker (lowering.rs empty-key pattern):
 
             result ∪ π_aggs(default − (default ⋈ marker))
 
         where marker is DISTINCT over a constant column of result (nonempty
         iff result is), so exactly one branch survives.
         """
+        from ..expr.scalar import null_sentinel
+
         n = len(e.aggregates)
         out_dtypes = self.dtypes(e)
         defaults = tuple(
-            0 if np.issubdtype(dt, np.integer) else np.float32(0.0)
-            for dt in out_dtypes
+            null_sentinel(dt)
+            if a.func in ("min", "max")
+            else (0 if np.issubdtype(dt, np.integer) else np.float32(0.0))
+            for a, dt in zip(e.aggregates, out_dtypes)
         )
         b = MfpBuilder(n)
         b.add_maps((Literal(1),))
@@ -247,7 +277,8 @@ class Lowerer:
             for i in acc_idx:
                 a = e.aggregates[i]
                 if a.func == "count":
-                    aggs.append(AggregateExpr("count", Literal(1)))
+                    # keep the argument: count(x) skips NULL inputs
+                    aggs.append(AggregateExpr("count", a.expr))
                 else:
                     dt = _expr_np_dtype(a.expr, in_dtypes)
                     accum = "float32" if dt == F32 else "int64"
@@ -271,6 +302,9 @@ class Lowerer:
                     group_cols=tuple(range(nk)),
                     order_by=((nk, a.func == "max"),),
                     limit=1,
+                    # NULL inputs never win min/max, but an all-NULL group
+                    # still yields its (NULL) row (SQL aggregate semantics)
+                    nulls_last=(True,),
                 ),
                 monotonic=is_monotonic(e.input, self.mono_ids),
             )

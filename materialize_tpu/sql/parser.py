@@ -363,9 +363,13 @@ class Parser:
                     desc = True
                 elif self.eat_kw("asc"):
                     pass
+                nulls_last = None
                 if self.eat_kw("nulls"):
-                    self.ident()
-                order_by.append(ast.OrderByItem(e, desc))
+                    pos = self.ident().lower()
+                    if pos not in ("first", "last"):
+                        raise ParseError(f"expected FIRST or LAST after NULLS, got {pos}")
+                    nulls_last = pos == "last"
+                order_by.append(ast.OrderByItem(e, desc, nulls_last))
                 if not self.eat_op(","):
                     break
         limit = None

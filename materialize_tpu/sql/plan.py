@@ -84,6 +84,7 @@ class RowSetFinishing:
     order_by: tuple = ()  # ((col_idx, desc), ...)
     limit: Optional[int] = None
     offset: int = 0
+    nulls_last: tuple = ()  # per order col; aligned with order_by
 
 
 @dataclass
@@ -146,9 +147,18 @@ class Planner:
             raise PlanError("aggregate not allowed here")
         if isinstance(e, _PostCol):
             return Column(e.index), scope.cols[e.index].typ
+        if isinstance(e, _PostSum):
+            # sum over an all-NULL (or empty) group is NULL, not 0
+            guard = CallBinary("gt", Column(e.cnt_col), Literal(0))
+            null = Literal(None, e.vt.dtype.name)
+            return CallVariadic("if", (guard, Column(e.sum_col), null)), e.vt
         if isinstance(e, _PostAvg):
             num = _to_float(Column(e.sum_col), e.vt)
-            den = CallUnary("cast_float", Column(e.cnt_col))
+            # nullif guard: a group whose inputs are all NULL has non-null
+            # count 0 and must yield NULL, not divide by zero
+            den = CallVariadic(
+                "nullif", (CallUnary("cast_float", Column(e.cnt_col)), Literal(0.0, "float32"))
+            )
             return CallBinary("div", num, den), FLOAT
         if isinstance(e, _PostStat):
             # var = (sum_sq - sum^2/n) / (n - ddof); stddev = sqrt(var)
@@ -180,7 +190,8 @@ class Planner:
         if isinstance(e, ast.BoolLit):
             return Literal(e.value, "bool"), BOOL
         if isinstance(e, ast.NullLit):
-            raise PlanError("NULL literals not supported yet (non-null engine)")
+            # untyped NULL: int64 carrier; 3VL makes the dtype inert
+            return Literal(None), INT
         if isinstance(e, ast.DateLit):
             from ..storage.generator import date_num
 
@@ -213,8 +224,8 @@ class Planner:
                 ors = ast.UnaryOp("not", ors)
             return self.plan_scalar(ors, scope)
         if isinstance(e, ast.IsNull):
-            # no NULLs in the engine yet: IS NULL = false, IS NOT NULL = true
-            return Literal(bool(e.negated), "bool"), BOOL
+            v, _t = self.plan_scalar(e.expr, scope)
+            return CallUnary("is_not_null" if e.negated else "is_null", v), BOOL
         if isinstance(e, ast.Case):
             return self._plan_case(e, scope)
         if isinstance(e, ast.Cast):
@@ -265,6 +276,21 @@ class Planner:
         if ColType.NUMERIC in (lt.col, rt.col):
             return PType(ColType.NUMERIC, max(lt.scale, rt.scale))
         return INT
+
+    def _common_type(self, lt: PType, rt: PType) -> PType:
+        t = self._arith_type(lt, rt)
+        if t.col == ColType.NUMERIC:
+            return PType(ColType.NUMERIC, max(lt.scale, rt.scale))
+        return t
+
+    def _align_to(self, e, t: PType, target: PType):
+        """Rescale/cast one planned expr to `target` (for n-ary alignment)."""
+        if target.col == ColType.NUMERIC:
+            from_scale = t.scale if t.col == ColType.NUMERIC else 0
+            return _rescale(e, from_scale, target.scale)
+        if target.col == ColType.FLOAT64 and t.col != ColType.FLOAT64:
+            return _to_float(e, t)
+        return e
 
     def _align(self, l, lt: PType, r, rt: PType):
         """Align numeric scales for add/sub/compare."""
@@ -335,6 +361,26 @@ class Planner:
         if name == "sqrt":
             v, vt = self.plan_scalar(e.args[0], scope)
             return CallUnary("sqrt", _to_float(v, vt)), FLOAT
+        if name == "coalesce":
+            if not e.args:
+                raise PlanError("coalesce needs at least one argument")
+            planned = [self.plan_scalar(a, scope) for a in e.args]
+            # common result type, then align every operand to it once
+            common = planned[0][1]
+            for _v, t in planned[1:]:
+                common = self._common_type(common, t)
+            aligned = tuple(
+                self._align_to(v, t, common) for v, t in planned
+            )
+            return CallVariadic("coalesce", aligned), common
+        if name == "nullif":
+            if len(e.args) != 2:
+                raise PlanError("nullif takes exactly two arguments")
+            l, lt = self.plan_scalar(e.args[0], scope)
+            r, rt = self.plan_scalar(e.args[1], scope)
+            # aligned values compare; the aligned type is what decodes them
+            l2, r2, t = self._align(l, lt, r, rt)
+            return CallVariadic("nullif", (l2, r2)), t
         raise PlanError(f"unsupported function: {name}")
 
     # -- relation planning ---------------------------------------------------
@@ -387,10 +433,15 @@ class Planner:
             rel = mir.MirLetRec(tuple(rec_bindings), rel)
         order, limit, offset = q.order_by, q.limit, q.offset
         order_idx = []
+        nulls_last = []
         for ob in order:
             idx = self._resolve_output_col(ob.expr, q.body, scope)
             order_idx.append((idx, ob.desc))
-        finishing = RowSetFinishing(tuple(order_idx), limit, offset)
+            nl = ob.nulls_last
+            nulls_last.append(not ob.desc if nl is None else nl)
+        finishing = RowSetFinishing(
+            tuple(order_idx), limit, offset, tuple(nulls_last)
+        )
         return PlannedQuery(rel, scope, finishing)
 
     def _resolve_output_col(self, e, body, scope: Scope) -> int:
@@ -569,28 +620,57 @@ class Planner:
         # NOT IN / NOT EXISTS antijoins: rel − (rel ⋉ sub), thresholded
         for key_ast, sub_pq, is_exists in lifter.antijoins:
             n = len(scope.cols)
+
+            def anti(rel_in, key_expr, sub_rel):
+                rel_k = mir.MirMap(rel_in, (key_expr,))
+                matched = mir.MirProject(
+                    mir.MirJoin(
+                        inputs=(rel_k, sub_rel),
+                        equivalences=((n, n + 1),),
+                    ),
+                    tuple(range(n)),
+                )
+                return mir.MirThreshold(
+                    mir.MirUnion((rel_in, mir.MirNegate(matched)))
+                )
+
             if is_exists:
-                key_expr = Literal(1)
                 sub_rel = mir.MirDistinct(
                     mir.MirProject(
                         mir.MirMap(sub_pq.mir, (Literal(1),)),
                         (len(sub_pq.scope.cols),),
                     )
                 )
-            else:
-                key_expr, _t = self.plan_scalar(key_ast, scope)
-                sub_rel = mir.MirDistinct(sub_pq.mir)
-            rel_k = mir.MirMap(rel, (key_expr,))
-            matched = mir.MirProject(
-                mir.MirJoin(
-                    inputs=(rel_k, sub_rel),
-                    equivalences=((n, n + 1),),
-                ),
-                tuple(range(n)),
+                rel = anti(rel, Literal(1), sub_rel)
+                continue
+            # NOT IN, three-valued (pg semantics): a NULL key row passes only
+            # when the subquery is EMPTY; if the subquery produces any NULL,
+            # no row passes (x NOT IN S is then NULL or FALSE for every x)
+            key_expr, _t = self.plan_scalar(key_ast, scope)
+            sub = sub_pq.mir  # arity 1
+            res0 = anti(
+                mir.MirFilter(rel, (CallUnary("is_not_null", key_expr),)),
+                key_expr,
+                mir.MirDistinct(sub),
             )
-            rel = mir.MirThreshold(
-                mir.MirUnion((rel, mir.MirNegate(matched)))
+            s_nonempty = mir.MirDistinct(
+                mir.MirProject(mir.MirMap(sub, (Literal(1),)), (1,))
             )
+            keep_null = anti(
+                mir.MirFilter(rel, (CallUnary("is_null", key_expr),)),
+                Literal(1),
+                s_nonempty,
+            )
+            s_null = mir.MirDistinct(
+                mir.MirProject(
+                    mir.MirMap(
+                        mir.MirFilter(sub, (CallUnary("is_null", Column(0)),)),
+                        (Literal(1),),
+                    ),
+                    (1,),
+                )
+            )
+            rel = anti(mir.MirUnion((res0, keep_null)), Literal(1), s_null)
 
         # 3. aggregates?
         has_group = bool(sel.group_by)
@@ -761,13 +841,130 @@ class Planner:
                 self._flatten_from(f.right, factors, scopes, on_preds)
                 return
             if f.kind != "inner":
-                raise PlanError(f"{f.kind} outer joins not supported yet")
+                rel, scope = self._plan_outer_join(f)
+                factors.append(rel)
+                scopes.append(scope)
+                return
             self._flatten_from(f.left, factors, scopes, on_preds)
             self._flatten_from(f.right, factors, scopes, on_preds)
             if f.on is not None:
                 on_preds.append(f.on)
             return
         raise PlanError(f"unsupported FROM clause {type(f).__name__}")
+
+    def _plan_factor_rel(self, f):
+        """Plan one table factor (incl. nested joins) to a (rel, scope)."""
+        factors: list = []
+        scopes: list[Scope] = []
+        on_preds: list = []
+        self._flatten_from(f, factors, scopes, on_preds)
+        scope = Scope([c for s in scopes for c in s.cols])
+        if len(factors) == 1:
+            rel = factors[0]
+        else:
+            offsets = []
+            off = 0
+            for s in scopes:
+                offsets.append(off)
+                off += len(s.cols)
+            equivs, residual = self._split_equalities(on_preds, scope, scopes, offsets)
+            rel = mir.MirJoin(
+                inputs=tuple(factors),
+                equivalences=tuple(tuple(sorted(c)) for c in equivs),
+            )
+            for c in residual:
+                p, _t = self.plan_scalar(c, scope)
+                rel = mir.MirFilter(rel, (p,))
+            on_preds = []
+        for c in on_preds:
+            p, _t = self.plan_scalar(c, scope)
+            rel = mir.MirFilter(rel, (p,))
+        return rel, scope
+
+    def _split_equalities(self, preds, full_scope, scopes, offsets):
+        """Partition conjuncts into join equivalence classes and residuals."""
+        conjuncts = []
+        for p in preds:
+            conjuncts.extend(_split_and(p))
+        equivs: list[set] = []
+        residual = []
+        for c in conjuncts:
+            pair = self._as_column_equality(c, full_scope, scopes, offsets)
+            if pair is not None:
+                merged = False
+                for cls in equivs:
+                    if pair[0] in cls or pair[1] in cls:
+                        cls.update(pair)
+                        merged = True
+                        break
+                if not merged:
+                    equivs.append(set(pair))
+            else:
+                residual.append(c)
+        return equivs, residual
+
+    def _plan_outer_join(self, f: ast.JoinClause):
+        """LEFT/RIGHT/FULL OUTER JOIN via the union/compensation lowering
+        (reference: HIR→MIR outer-join lowering, plan/lowering.rs:1581):
+
+            inner ∪ (unmatched preserved rows × NULL row for the other side)
+
+        where unmatched = preserved − (preserved ⋉ distinct matched rows),
+        the semijoin taken with null-safe (IS NOT DISTINCT FROM) equality so
+        preserved rows containing NULLs still count as matched.
+        """
+        lrel, lscope = self._plan_factor_rel(f.left)
+        rrel, rscope = self._plan_factor_rel(f.right)
+        n_l, n_r = len(lscope.cols), len(rscope.cols)
+        full_scope = Scope(list(lscope.cols) + list(rscope.cols))
+        if f.on is None:
+            raise PlanError("outer joins require an ON clause")
+        equivs, residual = self._split_equalities(
+            [f.on], full_scope, [lscope, rscope], [0, n_l]
+        )
+        inner = mir.MirJoin(
+            inputs=(lrel, rrel),
+            equivalences=tuple(tuple(sorted(c)) for c in equivs),
+        )
+        for c in residual:
+            p, _t = self.plan_scalar(c, full_scope)
+            inner = mir.MirFilter(inner, (p,))
+
+        def nulls_for(scope_cols):
+            return tuple(
+                Literal(None, t.col.dtype.name)
+                for t in (c.typ for c in scope_cols)
+            )
+
+        def compensation(side_rel, side_cols_range, other_scope_cols, reorder):
+            matched = mir.MirDistinct(mir.MirProject(inner, tuple(side_cols_range)))
+            n = len(side_cols_range)
+            semi = mir.MirJoin(
+                inputs=(side_rel, matched),
+                equivalences=tuple((i, n + i) for i in range(n)),
+                null_safe=True,
+            )
+            semi_kept = mir.MirProject(semi, tuple(range(n)))
+            unmatched = mir.MirUnion((side_rel, mir.MirNegate(semi_kept)))
+            padded = mir.MirMap(unmatched, nulls_for(other_scope_cols))
+            if reorder is not None:
+                padded = mir.MirProject(padded, reorder)
+            return padded
+
+        parts = [inner]
+        if f.kind in ("left", "full"):
+            parts.append(
+                compensation(lrel, range(n_l), rscope.cols, None)
+            )
+        if f.kind in ("right", "full"):
+            # Map appends NULL left-cols after the right row; reorder to
+            # (left NULLs, right cols)
+            reorder = tuple(range(n_r, n_r + n_l)) + tuple(range(n_r))
+            parts.append(
+                compensation(rrel, range(n_l, n_l + n_r), lscope.cols, reorder)
+            )
+        rel = mir.MirUnion(tuple(parts)) if len(parts) > 1 else parts[0]
+        return rel, full_scope
 
     def _as_column_equality(self, c, full_scope, scopes, offsets):
         """col = col crossing two inputs → (global_col_a, global_col_b)."""
@@ -863,8 +1060,11 @@ class Planner:
             if a.distinct:
                 raise PlanError("DISTINCT aggregates not supported yet")
             if fname == "count":
-                arg = Literal(1)
-                at = INT
+                # count(*) counts rows; count(x) counts non-null x
+                if a.args and not isinstance(a.args[0], ast.Star):
+                    arg, _at = self.plan_scalar(a.args[0], scope)
+                else:
+                    arg = Literal(1)
                 mir_aggs.append(mir.MirAggregate("count", arg))
                 post_agg_exprs.append(("col", len(mir_aggs) - 1, INT))
                 agg_types.append(INT)
@@ -872,7 +1072,8 @@ class Planner:
                 v, vt = self.plan_scalar(a.args[0], scope)
                 mir_aggs.append(mir.MirAggregate("sum", v))
                 sum_i = len(mir_aggs) - 1
-                mir_aggs.append(mir.MirAggregate("count", Literal(1)))
+                # avg divides by the NON-NULL input count
+                mir_aggs.append(mir.MirAggregate("count", v))
                 cnt_i = len(mir_aggs) - 1
                 post_agg_exprs.append(("avg", (sum_i, cnt_i, vt), FLOAT))
                 agg_types.extend([vt, INT])
@@ -887,6 +1088,15 @@ class Planner:
                 sq_t = PType(ColType.NUMERIC, vt.scale * 2) if vt.col == ColType.NUMERIC else vt
                 post_agg_exprs.append((fname, (sum_i, sq_i, cnt_i, vt), FLOAT))
                 agg_types.extend([vt, sq_t, INT])
+            elif fname == "sum":
+                v, vt = self.plan_scalar(a.args[0], scope)
+                mir_aggs.append(mir.MirAggregate("sum", v))
+                sum_i = len(mir_aggs) - 1
+                # paired non-null count: sum over only-NULL inputs is NULL
+                mir_aggs.append(mir.MirAggregate("count", v))
+                cnt_i = len(mir_aggs) - 1
+                post_agg_exprs.append(("sumn", (sum_i, cnt_i, vt), vt))
+                agg_types.extend([vt, INT])
             else:
                 v, vt = self.plan_scalar(a.args[0], scope)
                 out_t = vt if fname != "count" else INT
@@ -940,6 +1150,9 @@ class Planner:
             if kind == "avg":
                 sum_i, cnt_i, vt = payload
                 return _PostAvg(self._post_nkeys + sum_i, self._post_nkeys + cnt_i, vt)
+            if kind == "sumn":
+                sum_i, cnt_i, vt = payload
+                return _PostSum(self._post_nkeys + sum_i, self._post_nkeys + cnt_i, vt)
             sum_i, sq_i, cnt_i, vt = payload
             return _PostStat(
                 self._post_nkeys + sum_i,
@@ -971,6 +1184,13 @@ class _PostCol:
 
 @dataclass(frozen=True)
 class _PostAvg:
+    sum_col: int
+    cnt_col: int
+    vt: PType
+
+
+@dataclass(frozen=True)
+class _PostSum:
     sum_col: int
     cnt_col: int
     vt: PType
@@ -1236,4 +1456,5 @@ def _apply_finishing_as_topk(pq: PlannedQuery):
         order_by=tuple(pq.finishing.order_by),
         limit=pq.finishing.limit,
         offset=pq.finishing.offset,
+        nulls_last=tuple(pq.finishing.nulls_last) or None,
     )

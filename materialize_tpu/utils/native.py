@@ -74,13 +74,40 @@ def get_native():
 def consolidate_host(cols: dict) -> dict:
     """Consolidate host columnar updates {'c0':…, 'times':…, 'diffs':…}.
 
-    Uses the native kernel when every data column is 64-bit; NumPy/Python
-    fallback otherwise.
+    Columns are first canonicalized to 64-bit integer views (floats become
+    bit patterns with -0.0 folded and NaN — the float NULL sentinel —
+    canonicalized so NULL rows merge; narrower ints widen), mirroring the
+    device `value_view`. The native kernel then handles every layout.
     """
     data_keys = sorted(k for k in cols if k not in ("times", "diffs"))
     n = int(len(cols["times"]))
     if n == 0:
         return cols
+    restore: dict = {}
+    canon = {"times": cols["times"], "diffs": cols["diffs"]}
+    for k in data_keys:
+        a = np.asarray(cols[k])
+        if a.dtype.kind == "f":
+            f = a.astype(np.float32, copy=True)
+            f[f == 0.0] = np.float32(0.0)
+            f[np.isnan(f)] = np.float32(np.nan)
+            canon[k] = f.view(np.uint32).astype(np.int64)
+            restore[k] = ("f32", a.dtype)
+        elif a.dtype.kind in "iub" and a.dtype.itemsize < 8:
+            canon[k] = a.astype(np.int64)
+            restore[k] = ("cast", a.dtype)
+        else:
+            canon[k] = a
+    out = _consolidate_host_64(canon, data_keys, n)
+    for k, (kind, dt) in restore.items():
+        if kind == "f32":
+            out[k] = out[k].astype(np.uint32).view(np.float32).astype(dt)
+        else:
+            out[k] = out[k].astype(dt)
+    return out
+
+
+def _consolidate_host_64(cols: dict, data_keys, n: int) -> dict:
     lib = get_native()
     ok_64 = all(cols[k].dtype.itemsize == 8 and cols[k].dtype.kind in "iu" for k in data_keys)
     if lib is not None and ok_64:

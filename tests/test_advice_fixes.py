@@ -98,12 +98,13 @@ def test_global_count_empty_then_filled(coord):
     coord.execute("DELETE FROM t WHERE a >= 0")
     r = coord.execute("SELECT count(*) FROM t")
     assert r.rows == [(0,)]
-    # sum/min/max over empty: NULL in SQL — no representable default until
-    # NULLs land, so no row (documented gap, gated in lower_reduce)
-    assert coord.execute("SELECT sum(a) FROM t").rows == []
-    assert coord.execute("SELECT max(a) FROM t").rows == []
-    # avg must not fabricate a division-by-zero over empty input
-    assert coord.execute("SELECT avg(a) FROM t").rows == []
+    # global aggregates over empty input: one row, NULL for sum/avg/min/max
+    assert coord.execute("SELECT sum(a) FROM t").rows == [(None,)]
+    assert coord.execute("SELECT avg(a) FROM t").rows == [(None,)]
+    assert coord.execute("SELECT max(a) FROM t").rows == [(None,)]
+    assert coord.execute("SELECT count(*), max(a), sum(a) FROM t").rows == [
+        (0, None, None)
+    ]
 
 
 def test_global_aggregate_empty_in_materialized_view(coord):

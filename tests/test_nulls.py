@@ -1,0 +1,215 @@
+"""SQL NULL semantics: 3VL, aggregates, joins, outer joins, ordering.
+
+NULL is represented in-band (per-dtype sentinels, expr/scalar.py); these
+tests pin the visible SQL behavior against PostgreSQL semantics, including
+the adversarial cases from the round-2 code review (float NULL retraction,
+BOOL sentinel on the host fast path, all-NULL aggregates, NOT IN 3VL).
+"""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+
+
+@pytest.fixture
+def coord():
+    return Coordinator()
+
+
+def test_null_basics(coord):
+    coord.execute("CREATE TABLE t (a int, b int)")
+    coord.execute("INSERT INTO t VALUES (1, 10), (2, NULL), (NULL, 30)")
+    assert coord.execute("SELECT a FROM t WHERE b IS NULL").rows == [(2,)]
+    assert coord.execute(
+        "SELECT a FROM t WHERE a IS NOT NULL ORDER BY a"
+    ).rows == [(1,), (2,)]
+    # NULL propagates through arithmetic; comparisons with NULL filter
+    assert sorted(coord.execute("SELECT a + b FROM t").rows, key=repr) == [
+        (11,), (None,), (None,)
+    ]
+    assert sorted(coord.execute("SELECT a FROM t WHERE b > 5").rows, key=repr) == [
+        (1,), (None,)
+    ]
+
+
+def test_null_order_by_placement(coord):
+    coord.execute("CREATE TABLE t (a int)")
+    coord.execute("INSERT INTO t VALUES (2), (NULL), (1)")
+    assert coord.execute("SELECT a FROM t ORDER BY a").rows == [(1,), (2,), (None,)]
+    assert coord.execute("SELECT a FROM t ORDER BY a DESC").rows == [
+        (None,), (2,), (1,)
+    ]
+
+
+def test_null_aggregates(coord):
+    coord.execute("CREATE TABLE t (a int, b int)")
+    coord.execute("INSERT INTO t VALUES (1, 10), (2, NULL), (NULL, 30)")
+    assert coord.execute("SELECT count(*), count(a), count(b) FROM t").rows == [
+        (3, 2, 2)
+    ]
+    assert coord.execute("SELECT sum(a), min(a), max(a) FROM t").rows == [(3, 1, 2)]
+    # avg divides by the non-null count
+    assert coord.execute("SELECT avg(b) FROM t").rows == [(20.0,)]
+
+
+def test_all_null_group_aggregates(coord):
+    coord.execute("CREATE TABLE g (k int, a int)")
+    coord.execute("INSERT INTO g VALUES (1, NULL), (2, 5)")
+    r = coord.execute("SELECT k, max(a) FROM g GROUP BY k ORDER BY k")
+    assert r.rows == [(1, None), (2, 5)]
+    r = coord.execute("SELECT k, min(a) FROM g GROUP BY k ORDER BY k")
+    assert r.rows == [(1, None), (2, 5)]
+    # avg over an all-NULL group is NULL, not a division error
+    r = coord.execute("SELECT k, avg(a) FROM g GROUP BY k ORDER BY k")
+    assert r.rows == [(1, None), (2, 5.0)]
+    # mixed collation: count survives even when min/max group is all NULL
+    r = coord.execute("SELECT k, count(*), max(a) FROM g GROUP BY k ORDER BY k")
+    assert r.rows == [(1, 1, None), (2, 1, 5)]
+
+
+def test_float_null_retraction_roundtrip(coord):
+    # NaN is the float NULL sentinel; insert+delete must annihilate
+    coord.execute("CREATE TABLE f (x float)")
+    coord.execute("INSERT INTO f VALUES (NULL)")
+    coord.execute("DELETE FROM f WHERE x IS NULL")
+    assert coord.execute("SELECT x FROM f").rows == []
+    coord.execute("INSERT INTO f VALUES (NULL), (1.5)")
+    assert sorted(coord.execute("SELECT x FROM f").rows, key=repr) == [
+        (1.5,), (None,)
+    ]
+    coord.execute("DELETE FROM f WHERE x IS NOT NULL")
+    assert coord.execute("SELECT x FROM f").rows == [(None,)]
+
+
+def test_bool_null_fast_path(coord):
+    coord.execute("CREATE TABLE t (id int, b bool)")
+    coord.execute("INSERT INTO t VALUES (1, NULL), (2, true), (3, false)")
+    assert coord.execute("SELECT id FROM t WHERE b IS NULL").rows == [(1,)]
+    assert coord.execute("SELECT id FROM t WHERE b").rows == [(2,)]
+    assert coord.execute("SELECT id, b FROM t ORDER BY id").rows == [
+        (1, None), (2, True), (3, False)
+    ]
+
+
+def test_null_group_by_groups_together(coord):
+    coord.execute("CREATE TABLE t (k int, v int)")
+    coord.execute("INSERT INTO t VALUES (NULL, 1), (NULL, 2), (1, 3)")
+    r = sorted(coord.execute("SELECT k, sum(v) FROM t GROUP BY k").rows, key=repr)
+    assert r == [(1, 3), (None, 3)]
+    r = sorted(coord.execute("SELECT DISTINCT k FROM t").rows, key=repr)
+    assert r == [(1,), (None,)]
+
+
+def test_join_null_keys_never_match(coord):
+    coord.execute("CREATE TABLE a (x int)")
+    coord.execute("CREATE TABLE b (y int)")
+    coord.execute("INSERT INTO a VALUES (1), (NULL)")
+    coord.execute("INSERT INTO b VALUES (1), (NULL)")
+    assert coord.execute("SELECT a.x, b.y FROM a, b WHERE a.x = b.y").rows == [(1, 1)]
+
+
+def test_not_in_three_valued(coord):
+    coord.execute("CREATE TABLE t (x int)")
+    coord.execute("CREATE TABLE u (y int)")
+    coord.execute("CREATE TABLE v (z int)")
+    coord.execute("INSERT INTO t VALUES (NULL), (1)")
+    coord.execute("INSERT INTO u VALUES (2)")
+    coord.execute("INSERT INTO v VALUES (NULL), (1)")
+    # NULL key row is filtered when the subquery is nonempty
+    assert coord.execute(
+        "SELECT x FROM t WHERE x NOT IN (SELECT y FROM u)"
+    ).rows == [(1,)]
+    # subquery containing NULL filters everything
+    assert coord.execute(
+        "SELECT x FROM t WHERE x NOT IN (SELECT z FROM v)"
+    ).rows == []
+    # empty subquery: everything passes, even the NULL key row
+    assert sorted(
+        coord.execute(
+            "SELECT x FROM t WHERE x NOT IN (SELECT y FROM u WHERE y > 99)"
+        ).rows,
+        key=repr,
+    ) == [(1,), (None,)]
+
+
+def test_coalesce_nullif_case(coord):
+    coord.execute("CREATE TABLE t (a int)")
+    coord.execute("INSERT INTO t VALUES (1), (NULL)")
+    assert sorted(coord.execute("SELECT coalesce(a, -1) FROM t").rows) == [(-1,), (1,)]
+    assert sorted(
+        coord.execute("SELECT nullif(a, 1) FROM t").rows, key=repr
+    ) == [(None,), (None,)]
+    r = sorted(
+        coord.execute(
+            "SELECT CASE WHEN a IS NULL THEN 0 ELSE a END FROM t"
+        ).rows
+    )
+    assert r == [(0,), (1,)]
+
+
+def test_outer_joins(coord):
+    coord.execute("CREATE TABLE a (id int, x int)")
+    coord.execute("CREATE TABLE b (id int, y int)")
+    coord.execute("INSERT INTO a VALUES (1, 10), (2, 20)")
+    coord.execute("INSERT INTO b VALUES (1, 100), (3, 300)")
+    assert sorted(
+        coord.execute(
+            "SELECT a.id, b.y FROM a LEFT JOIN b ON a.id = b.id"
+        ).rows,
+        key=repr,
+    ) == [(1, 100), (2, None)]
+    assert sorted(
+        coord.execute(
+            "SELECT a.x, b.id FROM a RIGHT JOIN b ON a.id = b.id"
+        ).rows,
+        key=repr,
+    ) == [(10, 1), (None, 3)]
+    assert sorted(
+        coord.execute(
+            "SELECT a.id, b.id FROM a FULL OUTER JOIN b ON a.id = b.id"
+        ).rows,
+        key=repr,
+    ) == [(1, 1), (2, None), (None, 3)]
+
+
+def test_outer_join_incremental_mv(coord):
+    coord.execute("CREATE TABLE a (id int, x int)")
+    coord.execute("CREATE TABLE b (id int, y int)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW lj AS "
+        "SELECT a.id, b.y FROM a LEFT JOIN b ON a.id = b.id"
+    )
+    coord.execute("INSERT INTO a VALUES (1, 10)")
+    assert coord.execute("SELECT * FROM lj").rows == [(1, None)]
+    coord.execute("INSERT INTO b VALUES (1, 100)")
+    assert coord.execute("SELECT * FROM lj").rows == [(1, 100)]
+    coord.execute("DELETE FROM b WHERE id = 1")
+    assert coord.execute("SELECT * FROM lj").rows == [(1, None)]
+    # preserved row with NULLs in non-key columns stays correct
+    coord.execute("INSERT INTO a VALUES (2, NULL)")
+    assert sorted(coord.execute("SELECT * FROM lj").rows, key=repr) == [
+        (1, None), (2, None)
+    ]
+
+
+def test_update_with_nulls(coord):
+    coord.execute("CREATE TABLE t (id int, v int)")
+    coord.execute("INSERT INTO t VALUES (1, 10), (2, NULL)")
+    coord.execute("UPDATE t SET v = v + 1 WHERE id = 1")
+    assert sorted(coord.execute("SELECT id, v FROM t").rows) == [(1, 11), (2, None)]
+    coord.execute("UPDATE t SET v = coalesce(v, 0) WHERE id = 2")
+    assert sorted(coord.execute("SELECT id, v FROM t").rows) == [(1, 11), (2, 0)]
+
+
+def test_insert_missing_columns_default_null(coord):
+    coord.execute("CREATE TABLE t (a int, b int)")
+    coord.execute("INSERT INTO t (a) VALUES (7)")
+    assert coord.execute("SELECT a, b FROM t").rows == [(7, None)]
+
+
+def test_coalesce_nullif_numeric_alignment(coord):
+    coord.execute("CREATE TABLE t (a int, b int, p numeric(10, 2))")
+    coord.execute("INSERT INTO t VALUES (NULL, 5, 1.25)")
+    assert coord.execute("SELECT coalesce(a, b, p) FROM t").rows == [(5.0,)]
+    assert coord.execute("SELECT nullif(b, p) FROM t").rows == [(5.0,)]
+    assert coord.execute("SELECT coalesce(a, p) FROM t").rows == [(1.25,)]

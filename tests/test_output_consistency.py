@@ -24,14 +24,23 @@ class QueryGen:
         r = self.rng.random()
         if depth > 1 or r < 0.35:
             return self.rng.choice(cols)
-        if r < 0.55:
+        if r < 0.5:
             return str(int(self.rng.integers(-10, 10)))
+        if r < 0.56:
+            return "NULL"
+        if r < 0.64:
+            a = self.scalar(cols, depth + 1)
+            b = self.scalar(cols, depth + 1)
+            return f"coalesce({a}, {b})"
         a = self.scalar(cols, depth + 1)
         b = self.scalar(cols, depth + 1)
         op = self.rng.choice(["+", "-", "*"])
         return f"({a} {op} {b})"
 
     def predicate(self, cols):
+        if self.rng.random() < 0.15:
+            neg = "NOT " if self.rng.random() < 0.5 else ""
+            return f"{self.rng.choice(cols)} IS {neg}NULL"
         a = self.scalar(cols)
         b = self.scalar(cols)
         op = self.rng.choice(["=", "<>", "<", "<=", ">", ">="])
@@ -66,13 +75,20 @@ class QueryGen:
                 q += f" WHERE {self.predicate(cols)}"
             q += " GROUP BY a"
             return q
-        if kind < 0.75:
+        if kind < 0.7:
             # join
             q = (
                 "SELECT t1.a, t1.b, t2.y FROM t1, t2 WHERE t1.a = t2.x"
             )
             if self.rng.random() < 0.5:
                 q += f" AND {self.predicate(['t1.b', 't2.y'])}"
+            return q
+        if kind < 0.75:
+            # outer join (LEFT / nested expr on the preserved side)
+            jk = self.rng.choice(["LEFT", "LEFT OUTER"])
+            q = f"SELECT t1.a, t1.b, t2.y FROM t1 {jk} JOIN t2 ON t1.a = t2.x"
+            if self.rng.random() < 0.4:
+                q += " WHERE t2.y IS NULL"
             return q
         if kind < 0.82:
             # set op over same-arity selects
@@ -91,7 +107,10 @@ class QueryGen:
         if kind < 0.97:
             # deterministic ORDER BY + LIMIT (full column order disambiguates)
             k = int(self.rng.integers(1, 8))
-            return f"SELECT a, b, c FROM t1 ORDER BY a, b, c LIMIT {k}"
+            nl = self.rng.choice(["NULLS FIRST", "NULLS LAST"])
+            return (
+                f"SELECT a, b, c FROM t1 ORDER BY a {nl}, b {nl}, c {nl} LIMIT {k}"
+            )
         # distinct
         return "SELECT DISTINCT b FROM t1"
 
@@ -100,44 +119,63 @@ class QueryGen:
 def test_output_consistency_vs_sqlite(seed):
     rng = np.random.default_rng(seed)
     n1, n2 = 40, 25
+    def with_nulls(a, frac=0.15):
+        vals = a.tolist()
+        return [
+            None if rng.random() < frac else v for v in vals
+        ]
+
     t1 = {
-        "a": rng.integers(-5, 6, n1),
-        "b": rng.integers(-20, 21, n1),
-        "c": rng.integers(0, 4, n1),
+        "a": with_nulls(rng.integers(-5, 6, n1)),
+        "b": with_nulls(rng.integers(-20, 21, n1)),
+        "c": with_nulls(rng.integers(0, 4, n1)),
     }
-    t2 = {"x": rng.integers(-5, 6, n2), "y": rng.integers(-20, 21, n2)}
+    t2 = {
+        "x": with_nulls(rng.integers(-5, 6, n2)),
+        "y": with_nulls(rng.integers(-20, 21, n2)),
+    }
 
     lite = sqlite3.connect(":memory:")
     lite.execute("CREATE TABLE t1 (a int, b int, c int)")
     lite.execute("CREATE TABLE t2 (x int, y int)")
     lite.executemany(
         "INSERT INTO t1 VALUES (?,?,?)",
-        list(zip(t1["a"].tolist(), t1["b"].tolist(), t1["c"].tolist())),
+        list(zip(t1["a"], t1["b"], t1["c"])),
     )
     lite.executemany(
-        "INSERT INTO t2 VALUES (?,?)", list(zip(t2["x"].tolist(), t2["y"].tolist()))
+        "INSERT INTO t2 VALUES (?,?)", list(zip(t2["x"], t2["y"]))
     )
 
     coord = Coordinator()
     coord.execute("CREATE TABLE t1 (a int, b int, c int)")
     coord.execute("CREATE TABLE t2 (x int, y int)")
+    def lit(v):
+        return "NULL" if v is None else str(v)
+
     vals1 = ", ".join(
-        f"({a}, {b}, {c})"
+        f"({lit(a)}, {lit(b)}, {lit(c)})"
         for a, b, c in zip(t1["a"], t1["b"], t1["c"])
     )
-    vals2 = ", ".join(f"({x}, {y})" for x, y in zip(t2["x"], t2["y"]))
+    vals2 = ", ".join(f"({lit(x)}, {lit(y)})" for x, y in zip(t2["x"], t2["y"]))
     coord.execute(f"INSERT INTO t1 VALUES {vals1}")
     coord.execute(f"INSERT INTO t2 VALUES {vals2}")
+
+    def norm(row):
+        return tuple(None if v is None else int(v) for v in row)
+
+    def sort_key(row):
+        return tuple((v is None, 0 if v is None else v) for v in row)
 
     gen = QueryGen(rng)
     n_q = 30
     for qi in range(n_q):
         q = gen.query()
         ordered = "ORDER BY" in q
-        lite_rows = [tuple(int(v) for v in row) for row in lite.execute(q)]
-        mzt_rows = [tuple(int(v) for v in row) for row in coord.execute(q).rows]
+        lite_rows = [norm(row) for row in lite.execute(q)]
+        mzt_rows = [norm(row) for row in coord.execute(q).rows]
         if not ordered:
-            lite_rows, mzt_rows = sorted(lite_rows), sorted(mzt_rows)
+            lite_rows.sort(key=sort_key)
+            mzt_rows.sort(key=sort_key)
         assert mzt_rows == lite_rows, (
             f"query #{qi} diverged: {q}\n got:  {mzt_rows}\n want: {lite_rows}"
         )

@@ -92,11 +92,24 @@ def test_min_via_top1():
     run_scenario(ticks, plan)
 
 
-def test_desc_order_int64_min_and_zero():
-    """Descending order must survive INT64_MIN (negation overflow trap)."""
+def test_desc_order_near_int64_min_and_zero():
+    """Descending order must survive INT64_MIN+1 (negation overflow trap).
+
+    INT64_MIN itself is reserved as the in-band NULL sentinel
+    (expr/scalar.py) and sorts by NULL-placement rules, not value order.
+    """
     plan = TopKPlan(group_cols=(0,), order_by=((1, True),), limit=1)
-    lo = np.iinfo(np.int64).min
+    lo = np.iinfo(np.int64).min + 1
     run_scenario([([np.array([1, 1]), np.array([lo, 5])], [1, 1])], plan)
+
+
+def test_desc_order_null_sentinel_loses():
+    """A NULL (sentinel) value never wins min/max-style selection."""
+    plan = TopKPlan(
+        group_cols=(0,), order_by=((1, True),), limit=1, nulls_last=(True,)
+    )
+    null = np.iinfo(np.int64).min  # in-band NULL
+    run_scenario([([np.array([1, 1]), np.array([null, 5])], [1, 1])], plan)
 
 
 def test_topk_random(rng):
