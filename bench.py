@@ -176,9 +176,12 @@ class NumpyQ3:
                 for (pe, pd) in self.li_by_order.get(key, ()):  # li arrived first
                     self._bump(key, pe, pd, 1)
             else:
-                self.orders.pop(key, None)
-                for (pe, pd) in self.li_by_order.get(key, ()):
-                    self._bump_del(key)
+                meta = self.orders.pop(key, None)
+                if meta is not None:
+                    # order retracted: its group vanishes wholesale (O(1);
+                    # scanning all groups per lineitem was quadratic and
+                    # unfairly slowed the baseline at SF>=1)
+                    self.groups.pop((key, meta[0], meta[1]), None)
         lmask = sd > self.q3_date
         for i in np.nonzero(lmask)[0]:
             key = int(lk[i])
@@ -201,10 +204,6 @@ class NumpyQ3:
         if self.groups[g] == 0:
             del self.groups[g]
 
-    def _bump_del(self, key):
-        # order retracted: remove the whole group
-        for g in [g for g in self.groups if g[0] == key]:
-            del self.groups[g]
 
 
 def run_cpu_baseline(sf, ticks, frac, seed=0):
@@ -254,7 +253,7 @@ def _device_preflight() -> bool:
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=180,
+            timeout=int(os.environ.get("MZT_PREFLIGHT_TIMEOUT", "300")),
             capture_output=True,
         )
         return r.returncode == 0

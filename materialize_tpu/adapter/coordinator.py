@@ -126,19 +126,26 @@ class Coordinator:
 
         return SessionConfigs(self.configs)
 
-    def execute(self, sql: str, session=None) -> ExecResult:
+    def execute(self, sql: str, session=None, params=None) -> ExecResult:
         stmt = parse_statement(sql)
-        return self.execute_stmt(stmt, session)
+        return self.execute_stmt(stmt, session, params=params)
 
-    def execute_script(self, sql: str, session=None) -> list[ExecResult]:
-        return [self.execute_stmt(s, session) for s in parse_statements(sql)]
+    def execute_script(self, sql: str, session=None, params=None) -> list[ExecResult]:
+        return [
+            self.execute_stmt(s, session, params=params)
+            for s in parse_statements(sql)
+        ]
 
-    def execute_stmt(self, stmt, session=None) -> ExecResult:
+    def execute_stmt(self, stmt, session=None, params=None) -> ExecResult:
         from ..utils.tracing import TRACER
 
         self._session = session  # per-statement; coordinator is single-threaded
-        with TRACER.span(f"execute:{type(stmt).__name__}"):
-            return self._execute_stmt_inner(stmt)
+        self.planner.set_params(params)
+        try:
+            with TRACER.span(f"execute:{type(stmt).__name__}"):
+                return self._execute_stmt_inner(stmt)
+        finally:
+            self.planner.set_params(None)
 
     def _cfg(self):
         """Effective configs: session overlay when a session is active."""
@@ -585,6 +592,24 @@ class Coordinator:
         return ExecResult("status", status=f"UPDATE {n}")
 
     def _literal_value(self, e, cdesc: ColumnDesc):
+        if isinstance(e, ast.Param):
+            # extended-protocol parameter: re-dispatch the bound text value
+            # as the equivalent literal AST (typed by the target column)
+            ps = self.planner._params
+            if ps is None or not (1 <= e.index <= len(ps)):
+                raise PlanError(f"parameter ${e.index} not bound")
+            v = ps[e.index - 1]
+            if v is None:
+                return self._literal_value(ast.NullLit(), cdesc)
+            if cdesc.typ == ColType.STRING:
+                return self.catalog.dict.encode(v)
+            if cdesc.typ == ColType.BOOL:
+                return v.lower() in ("t", "true", "1")
+            import re as _re
+
+            if _re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
+                return self._literal_value(ast.DateLit(v), cdesc)
+            return self._literal_value(ast.NumberLit(v.lstrip("+")), cdesc)
         if isinstance(e, ast.NullLit):
             from ..expr.scalar import null_sentinel
 
@@ -598,9 +623,13 @@ class Coordinator:
         if isinstance(e, ast.NumberLit):
             if cdesc.typ == ColType.NUMERIC:
                 if "." in e.value:
-                    ip, fp = e.value.split(".")
+                    # sign applies to the WHOLE value: int('-1')*100 + 50 would
+                    # yield -50 for '-1.50' instead of -150
+                    neg = e.value.lstrip().startswith("-")
+                    ip, fp = e.value.lstrip().lstrip("-").split(".")
                     fp = (fp + "0" * cdesc.scale)[: cdesc.scale]
-                    return int(ip or "0") * 10**cdesc.scale + int(fp)
+                    mag = int(ip or "0") * 10**cdesc.scale + int(fp or "0")
+                    return -mag if neg else mag
                 return int(e.value) * 10**cdesc.scale
             if "." in e.value:
                 return float(e.value)
@@ -914,6 +943,18 @@ class Coordinator:
         for gid, store in self.storage.items():
             if hasattr(store, "arr"):
                 store.arr.compact(since)
+        # persist maintenance: strided so the CAS/gc cost amortizes across
+        # ticks (the reference runs these as background maintenance tasks,
+        # src/persist-client/src/internal/maintenance.rs)
+        if self.durable and ts % 16 == 0:
+            for _gid, m in list(self.shards.items()):
+                try:
+                    m.downgrade_since(since)
+                    if ts % 64 == 0:
+                        m.compact()
+                        m.gc()
+                except (IOError, RuntimeError):
+                    pass  # best-effort; the next maintenance pass retries
 
     def advance(self, n_rows: int = 100) -> int:
         """Pull one batch from every generator source and commit it."""
@@ -1354,6 +1395,11 @@ def explain_mir(e, indent: int = 0) -> str:
         extra = f" keys={list(e.group_key)} aggs={[a.func for a in e.aggregates]}"
     if isinstance(e, mir.MirTopK):
         extra = f" group={list(e.group_key)} limit={e.limit}"
+    if isinstance(e, mir.MirWindow):
+        extra = (
+            f" partition={list(e.partition_cols)}"
+            f" funcs={[f.func for f in e.funcs]}"
+        )
     lines = [f"{pad}{name}{extra}"]
     for k in mir.children(e):
         lines.append(explain_mir(k, indent + 1))

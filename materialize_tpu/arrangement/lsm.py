@@ -170,18 +170,25 @@ def _empty_accum_like(s: AccumState) -> AccumState:
 
 
 def accum_lsm_lookup(lsm: LsmAccums, probe: AccumState):
-    """Total accumulators for probe keys: sum of per-level partials."""
+    """Total accumulators for probe keys: sum of per-level partials.
+
+    Returns (accums, nrows, missed): `missed` is True for any probe whose
+    hash bucket exceeded the lookup scan on some level — the result is then
+    unsound and the caller must flag the tick (see lookup_accums)."""
     tot_accums = None
     tot_nrows = None
+    missed_any = None
     for level in lsm.levels:
-        _f, accs, nrows = lookup_accums(level, probe)
+        _f, accs, nrows, missed = lookup_accums(level, probe)
         if tot_accums is None:
             tot_accums = list(accs)
             tot_nrows = nrows
+            missed_any = missed
         else:
             tot_accums = [a + b for a, b in zip(tot_accums, accs)]
             tot_nrows = tot_nrows + nrows
-    return tuple(tot_accums), tot_nrows
+            missed_any = missed_any | missed
+    return tuple(tot_accums), tot_nrows, missed_any
 
 
 def accum_lsm_insert(lsm: LsmAccums, contrib: AccumState, tick, ratio: int = 4):

@@ -130,14 +130,25 @@ class Arrangement:
             mask = cols["times"] <= np.uint64(at)
             cols = {k: v[mask] for k, v in cols.items()}
         out = consolidate_host(cols)
-        rows = []
         n = len(out["times"])
-        for i in range(n):
-            data = tuple(
-                _host_value(out[f"c{j}"][i]) for j in range(ncols)
-            )
-            rows.append((data, int(out["times"][i]), int(out["diffs"][i])))
-        return rows
+        # bulk column→list conversion (C loop) instead of per-cell .item();
+        # float NaN (the float NULL sentinel) becomes None so NULL rows
+        # accumulate/compare correctly in host dicts
+        col_lists = []
+        for j in range(ncols):
+            c = out[f"c{j}"]
+            lst = c.tolist()
+            if c.dtype.kind == "f":
+                lst = [None if x != x else x for x in lst]
+            col_lists.append(lst)
+        times_l = out["times"].tolist()
+        diffs_l = out["diffs"].tolist()
+        if not col_lists:
+            return [((), int(t), int(d)) for t, d in zip(times_l, diffs_l)]
+        return [
+            (data, int(t), int(d))
+            for data, t, d in zip(zip(*col_lists), times_l, diffs_l)
+        ]
 
     def count(self) -> int:
         return sum(int(b.count()) for b in self.batches)

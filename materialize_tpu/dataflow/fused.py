@@ -402,7 +402,10 @@ class FusedCompiler:
         raw, errs = _contributions(inp, e.key_cols, e.aggs)
         ctx.errs.append(errs)
         contrib = consolidate_accums(raw)
-        old_accums, old_nrows = accum_lsm_lookup(lsm, contrib)
+        old_accums, old_nrows, missed = accum_lsm_lookup(lsm, contrib)
+        from ..ops.reduce import collision_errs
+
+        ctx.errs.append(collision_errs(contrib, missed, ctx.time))
         out = consolidate(_emit_output(contrib, old_accums, old_nrows, ctx.time))
         new_lsm, f = accum_lsm_insert(lsm, contrib, ctx.time, self.caps.ratio)
         ctx.overflow.append(f)
@@ -420,7 +423,10 @@ class FusedCompiler:
         inp = self._emit(e.input, ctx)
         raw, _errs = _contributions(inp, tuple(key_cols), ())
         contrib = consolidate_accums(raw)
-        _accs, old_n = accum_lsm_lookup(lsm, contrib)
+        _accs, old_n, missed = accum_lsm_lookup(lsm, contrib)
+        from ..ops.reduce import collision_errs
+
+        ctx.errs.append(collision_errs(contrib, missed, ctx.time))
         new_n = old_n + contrib.nrows
         out_d = _multiplicity(mode, new_n) - _multiplicity(mode, old_n)
         live = contrib.live & (out_d != 0)

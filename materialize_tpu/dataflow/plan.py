@@ -133,6 +133,18 @@ class TopK:
 
 
 @dataclass(frozen=True)
+class Window:
+    """Window functions over partitions (ops/window.py): output = input row
+    columns ++ one column per plan.funcs entry. The reference plans window
+    functions as reduce-based whole-group recomputation
+    (src/expr/src/relation/func.rs:1963); here the recompute is a batched
+    affected-partition kernel."""
+
+    input: Any
+    plan: Any  # ops.window.WindowPlan
+
+
+@dataclass(frozen=True)
 class Negate:
     input: Any
 

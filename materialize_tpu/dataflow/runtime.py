@@ -345,8 +345,10 @@ class DistinctNode(Node):
         if oks is None:
             return None if errs is None else (None, errs)
         projected = _project(oks, self.key_cols)
-        self.state, out = threshold_step(self.state, projected, "distinct", tick)
-        return out, errs
+        self.state, out, coll = threshold_step(
+            self.state, projected, "distinct", tick
+        )
+        return out, _union([errs, coll])
 
 
 class ThresholdNode(Node):
@@ -360,8 +362,8 @@ class ThresholdNode(Node):
         oks, errs = d
         if oks is None:
             return None if errs is None else (None, errs)
-        self.state, out = threshold_step(self.state, oks, "threshold", tick)
-        return out, errs
+        self.state, out, coll = threshold_step(self.state, oks, "threshold", tick)
+        return out, _union([errs, coll])
 
 
 class TopKNode(Node):
@@ -382,6 +384,40 @@ class TopKNode(Node):
 
     def compact(self, since):
         self.arr.compact(since)
+
+
+class WindowNode(Node):
+    """Window functions via affected-partition recompute (ops/window.py)."""
+
+    def __init__(self, wplan):
+        self.plan = wplan
+        self.arr = Arrangement(key_cols=wplan.partition_cols)
+
+    def step(self, tick, ins):
+        from ..ops.window import window_step
+
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        keyed = arrange_batch(oks, self.plan.partition_cols)
+        out = window_step(self.arr, keyed, self.plan, tick)
+        return out, errs
+
+    def compact(self, since):
+        self.arr.compact(since)
+
+    def state_info(self):
+        return [
+            (
+                "window_input",
+                len(self.arr.batches),
+                self.arr.total_cap(),
+                self.arr.count(),
+            )
+        ]
 
 
 class MonotonicTopKNode(Node):
@@ -806,6 +842,10 @@ class Dataflow:
             else:
                 ops.append((TopKNode(e.plan), [ref]))
             return len(ops) - 1
+        if isinstance(e, lir.Window):
+            ref = self._render(e.input, ops)
+            ops.append((WindowNode(e.plan), [ref]))
+            return len(ops) - 1
         if isinstance(e, lir.LetRec):
             ops.append((LetRecNode(e), list(e.external_ids)))
             return len(ops) - 1
@@ -837,6 +877,10 @@ class Dataflow:
             return self._infer_dtypes(e.inputs[0])
         if isinstance(e, lir.TopK):
             return self._infer_dtypes(e.input)
+        if isinstance(e, lir.Window):
+            return self._infer_dtypes(e.input) + tuple(
+                np.dtype(f.out_dtype) for f in e.plan.funcs
+            )
         if isinstance(e, lir.Reduce):
             ins = self._infer_dtypes(e.input)
             if e.distinct:

@@ -96,6 +96,31 @@ class MirTopK:
 
 
 @dataclass(frozen=True)
+class MirWindowFunc:
+    """func in {row_number, rank, dense_rank, ntile, lag, lead, first_value,
+    last_value, sum, count, min, max}; arg is an input column index (None for
+    argument-less funcs); offset = lag/lead distance or ntile buckets."""
+
+    func: str
+    arg: Optional[int] = None
+    offset: int = 1
+
+
+@dataclass(frozen=True)
+class MirWindow:
+    """Window functions: appends one column per func. The reference models
+    window functions as AggregateFunc variants inside a whole-group-recompute
+    reduce (src/expr/src/relation/func.rs:1963); this node is the explicit
+    TPU-side equivalent over affected partitions."""
+
+    input: Any
+    partition_cols: tuple  # input column indices
+    order_by: tuple  # ((col, desc), ...)
+    funcs: tuple  # of MirWindowFunc
+    nulls_last: Optional[tuple] = None
+
+
+@dataclass(frozen=True)
 class MirNegate:
     input: Any
 
@@ -159,6 +184,8 @@ def arity(e: MirExpr) -> int:
         return len(e.group_key) + len(e.aggregates)
     if isinstance(e, MirTopK):
         return arity(e.input)
+    if isinstance(e, MirWindow):
+        return arity(e.input) + len(e.funcs)
     if isinstance(e, (MirNegate, MirThreshold, MirDistinct)):
         return arity(e.input) if not isinstance(e, MirDistinct) else arity(e.input)
     if isinstance(e, MirUnion):
@@ -173,7 +200,7 @@ def arity(e: MirExpr) -> int:
 def children(e: MirExpr) -> tuple:
     if isinstance(e, (MirConstant, MirGet)):
         return ()
-    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirWindow, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
         return (e.input,)
     if isinstance(e, (MirJoin, MirUnion)):
         return tuple(e.inputs)
@@ -202,7 +229,7 @@ def collect_get_ids(e: MirExpr) -> set:
 def with_children(e: MirExpr, new: tuple) -> MirExpr:
     if isinstance(e, (MirConstant, MirGet)):
         return e
-    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirWindow, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
         return replace(e, input=new[0])
     if isinstance(e, (MirJoin, MirUnion)):
         return replace(e, inputs=tuple(new))

@@ -101,6 +101,11 @@ class EvalErr(enum.IntEnum):
     NONE = 0
     DIVISION_BY_ZERO = 1
     NUMERIC_OVERFLOW = 2
+    # reduce lookup scanned _MAX_HASH_COLLISIONS slots of one hash bucket
+    # without resolving the probe: the answer would be unsound, so the tick
+    # reports an error instead of silently dropping the group (needs >4
+    # distinct live keys sharing one 64-bit hash)
+    HASH_COLLISION_EXHAUSTED = 3
 
 
 @dataclass(frozen=True)

@@ -94,20 +94,6 @@ def _has_bare_semicolon(sql: str) -> bool:
     return False
 
 
-def _literalize(v: str | None) -> str:
-    if v is None:
-        return "NULL"
-    import re as _re
-
-    # bare numeric only when the text round-trips exactly (no leading zeros,
-    # no '+', …): '007' or '1.50' must stay strings or they'd be corrupted
-    if _re.fullmatch(r"-?(0|[1-9]\d*)", v):
-        return v
-    if _re.fullmatch(r"-?(0|[1-9]\d*)\.\d*[1-9]", v) or v in ("0.0",):
-        return v
-    return "'" + v.replace("'", "''") + "'"
-
-
 class PgConnection:
     def __init__(self, sock: socket.socket, coordinator: Coordinator, lock):
         self.sock = sock
@@ -116,7 +102,7 @@ class PgConnection:
         self.session = coordinator.new_session()
         # extended query protocol state (protocol.rs StateMachine analogue)
         self.statements: dict[str, str] = {}  # name -> sql with $n params
-        self.portals: dict[str, str] = {}  # name -> bound sql
+        self.portals: dict[str, tuple] = {}  # name -> (sql, bound param values)
         # after an error, skip messages until Sync (spec-mandated)
         self.in_error = False
 
@@ -317,24 +303,16 @@ class PgConnection:
         if sql is None:
             self._ext_error("26000", f"unknown prepared statement {stmt!r}")
             return
-        # substitute $n textually, skipping string literals (planner
-        # placeholder support is future work — extended-protocol compat shim)
-        spots = _scan_params(sql)
-        out = []
-        last = 0
-        for start, end, idx in spots:
-            out.append(sql[last:start])
-            if 1 <= idx <= len(params):
-                out.append(_literalize(params[idx - 1]))
-            else:
+        # parameters stay structured values bound at plan time ($n is a
+        # planner placeholder, ast.Param) — never spliced into SQL text
+        for _s, _e, idx in _scan_params(sql):
+            if not (1 <= idx <= len(params)):
                 self._ext_error("08P01", f"parameter ${idx} not bound")
                 return
-            last = end
-        out.append(sql[last:])
-        self.portals[portal] = "".join(out)
+        self.portals[portal] = (sql, tuple(params))
         self.sock.sendall(_msg(b"2", b""))  # BindComplete
 
-    def _describe_columns(self, sql: str):
+    def _describe_columns(self, sql: str, params=None):
         """Column (name, oid) pairs for a statement, or None for no result set."""
         from ..repr.types import ColType
         from ..sql import ast as _ast
@@ -344,7 +322,11 @@ class PgConnection:
         if not isinstance(stmt, _ast.SelectStatement):
             return None
         with self.lock:
-            pq = self.coord.planner.plan_query(stmt.query)
+            self.coord.planner.set_params(params)
+            try:
+                pq = self.coord.planner.plan_query(stmt.query)
+            finally:
+                self.coord.planner.set_params(None)
         oid_of = {
             ColType.INT64: _OID_INT8,
             ColType.INT32: _OID_INT8,
@@ -375,14 +357,16 @@ class PgConnection:
             self.sock.sendall(
                 _msg(b"t", struct.pack(">H", n_params) + struct.pack(">I", _OID_TEXT) * n_params)
             )
+            params = None
         else:
-            sql = self.portals.get(name)
-            if sql is None:
+            entry = self.portals.get(name)
+            if entry is None:
                 self._ext_error("34000", f"unknown portal {name!r}")
                 return
+            sql, params = entry
         # best-effort planning: statements may still contain unbound $n
         try:
-            cols = self._describe_columns(sql)
+            cols = self._describe_columns(sql, params)
         except Exception:
             cols = None
         if cols:
@@ -392,13 +376,14 @@ class PgConnection:
 
     def _handle_execute(self, payload: bytes) -> None:
         portal, off = self._read_cstr(payload, 0)
-        sql = self.portals.get(portal)
-        if sql is None:
+        entry = self.portals.get(portal)
+        if entry is None:
             self._ext_error("34000", f"unknown portal {portal!r}")
             return
+        sql, params = entry
         try:
             with self.lock:
-                results = self.coord.execute_script(sql, self.session)
+                results = self.coord.execute_script(sql, self.session, params=params)
         except Exception as e:
             self._ext_error("XX000", str(e))
             return

@@ -243,12 +243,17 @@ def q3_tick(
 
     raw_contrib, errs2 = _contributions(grouped, (0, 1, 2), _AGGS)
     contrib = consolidate_accums(raw_contrib)
-    old_accums, old_nrows = accum_lsm_lookup(state.accum, contrib)
+    old_accums, old_nrows, missed = accum_lsm_lookup(state.accum, contrib)
+    from ..ops.reduce import collision_errs
+
+    errs3 = collision_errs(contrib, missed, time)
     out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
     new_accum, f = accum_lsm_insert(state.accum, contrib, time, RATIO)
     track(f)
 
-    errs = consolidate(UpdateBatch.concat(errs1, errs2))
+    errs = consolidate(
+        UpdateBatch.concat(UpdateBatch.concat(errs1, errs2), errs3)
+    )
     new_state = Q3State(new_cust, new_ord_ck, new_ord_ok, new_li, new_accum)
     # overflow as shape-(1,) so shard_map can concatenate per-device flags
     return new_state, out, errs, over.reshape((1,))

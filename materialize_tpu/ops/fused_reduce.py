@@ -47,7 +47,12 @@ def fused_mfp_reduce_step(
         oks, errs1 = mfp.apply(delta)
     raw, errs2 = _contributions(oks, key_cols, aggs)
     contrib = consolidate_accums(raw)
-    _found, old_accums, old_nrows = lookup_accums(state, contrib)
+    _found, old_accums, old_nrows, missed = lookup_accums(state, contrib)
+    from .reduce import collision_errs
+
+    errs2 = consolidate(
+        UpdateBatch.concat(errs2, collision_errs(contrib, missed, time))
+    )
     out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
     new_state = consolidate_accums(AccumState.concat(state, contrib))
     errs = errs2 if errs1 is None else consolidate(UpdateBatch.concat(errs1, errs2))

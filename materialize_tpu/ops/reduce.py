@@ -211,9 +211,13 @@ def _contributions(delta: UpdateBatch, key_cols: tuple[int, ...], aggs):
 def lookup_accums(state: AccumState, probe: AccumState):
     """Gather state entries matching probe keys.
 
-    Returns (found[bool], accums tuple, nrows) aligned with probe rows.
-    Handles up to _MAX_HASH_COLLISIONS distinct keys per 64-bit hash.
-    """
+    Returns (found[bool], accums tuple, nrows, missed[bool]) aligned with
+    probe rows. Scans up to _MAX_HASH_COLLISIONS slots of the probe's hash
+    bucket; `missed` marks probes whose bucket is larger than the scan and
+    that were not resolved within it — the lookup result for those rows is
+    unsound and callers MUST surface an error rather than use it (the
+    detect-and-error stance; silently treating the group as absent would be
+    a wrong answer)."""
     lo = jnp.searchsorted(state.hashes, probe.hashes, side="left")
     hi = jnp.searchsorted(state.hashes, probe.hashes, side="right")
     found = jnp.zeros(probe.hashes.shape, dtype=jnp.bool_)
@@ -232,7 +236,24 @@ def lookup_accums(state: AccumState, probe: AccumState):
         found = found | eq
     accums = tuple(jnp.where(found, a[idx], 0) for a in state.accums)
     nrows = jnp.where(found, state.nrows[idx], 0)
-    return found, accums, nrows
+    missed = probe.live & ~found & ((hi - lo) > _MAX_HASH_COLLISIONS)
+    return found, accums, nrows, missed
+
+
+@jax.jit
+def collision_errs(probe: AccumState, missed, time) -> UpdateBatch:
+    """Error-collection rows for unresolved hash-bucket probes."""
+    from ..expr.scalar import EvalErr
+
+    t = jnp.asarray(time, dtype=jnp.uint64)
+    code = jnp.asarray(int(EvalErr.HASH_COLLISION_EXHAUSTED), jnp.int64)
+    return UpdateBatch(
+        hashes=jnp.where(missed, jnp.zeros_like(probe.hashes), PAD_HASH),
+        keys=(),
+        vals=(jnp.where(missed, code, 0),),
+        times=jnp.where(missed, t, PAD_TIME),
+        diffs=jnp.where(missed, 1, 0).astype(jnp.int64),
+    )
 
 
 @jax.jit
@@ -293,10 +314,13 @@ def accumulable_step(
     """
     raw_contrib, errs = _contributions(delta, key_cols, aggs)
     contrib = consolidate_accums(raw_contrib)
-    _found, old_accums, old_nrows = lookup_accums(state, contrib)
+    _found, old_accums, old_nrows, missed = lookup_accums(state, contrib)
     out = _emit_output(contrib, old_accums, old_nrows, time)
     from .consolidate import consolidate  # local import to avoid cycle
 
     out = consolidate(out)
+    errs = consolidate(
+        UpdateBatch.concat(errs, collision_errs(contrib, missed, time))
+    )
     new_state = consolidate_accums(AccumState.concat(state, contrib))
     return new_state, out, errs

@@ -33,12 +33,14 @@ def threshold_step(
     mode: str,
     time: int,
 ):
-    """One tick: (count_state, Δin, t) → (state', Δout) with Δout diffs
+    """One tick: (count_state, Δin, t) → (state', Δout, Δerrs) with Δout diffs
     f(new_count) − f(old_count) per touched row. Row columns are the key."""
+    from .reduce import collision_errs
+
     all_cols = tuple(range(len(delta.vals)))
     raw_contrib, _errs = _contributions(delta, all_cols, ())
     contrib = consolidate_accums(raw_contrib)
-    _found, _accs, old_n = lookup_accums(state, contrib)
+    _found, _accs, old_n, missed = lookup_accums(state, contrib)
     new_n = old_n + contrib.nrows
     out_d = _multiplicity(mode, new_n) - _multiplicity(mode, old_n)
     live = contrib.live & (out_d != 0)
@@ -51,4 +53,4 @@ def threshold_step(
         diffs=jnp.where(live, out_d, 0),
     )
     new_state = consolidate_accums(AccumState.concat(state, contrib))
-    return new_state, consolidate(out)
+    return new_state, consolidate(out), collision_errs(contrib, missed, time)

@@ -53,7 +53,12 @@ def fused_accumulable_step(
     cap = state.cap
     raw, errs = _contributions(delta, key_cols, aggs)
     contrib = consolidate_accums(raw)
-    _found, old_accums, old_nrows = lookup_accums(state, contrib)
+    _found, old_accums, old_nrows, missed = lookup_accums(state, contrib)
+    from ..ops.reduce import collision_errs
+
+    errs = consolidate(
+        UpdateBatch.concat(errs, collision_errs(contrib, missed, time))
+    )
     out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
     merged = consolidate_accums(AccumState.concat(state, contrib))
     overflow = merged.count() > cap

@@ -32,10 +32,15 @@ class Blob:
     def list_keys(self, prefix: str = "") -> list[str]:
         raise NotImplementedError
 
+    def stat_mtime(self, key: str) -> Optional[float]:
+        """Last-write unix time, or None if unknown/missing (GC grace checks)."""
+        return None
+
 
 class MemBlob(Blob):
     def __init__(self) -> None:
         self._data: dict[str, bytes] = {}
+        self._mtimes: dict[str, float] = {}
         self._lock = threading.Lock()
 
     def get(self, key):
@@ -43,16 +48,24 @@ class MemBlob(Blob):
             return self._data.get(key)
 
     def set(self, key, value):
+        import time
+
         with self._lock:
             self._data[key] = bytes(value)
+            self._mtimes[key] = time.time()
 
     def delete(self, key):
         with self._lock:
             self._data.pop(key, None)
+            self._mtimes.pop(key, None)
 
     def list_keys(self, prefix=""):
         with self._lock:
             return sorted(k for k in self._data if k.startswith(prefix))
+
+    def stat_mtime(self, key):
+        with self._lock:
+            return self._mtimes.get(key)
 
 
 class FileBlob(Blob):
@@ -99,6 +112,12 @@ class FileBlob(Blob):
             if key.startswith(prefix) and not name.startswith("tmp"):
                 out.append(key)
         return sorted(out)
+
+    def stat_mtime(self, key):
+        try:
+            return os.stat(self._path(key)).st_mtime
+        except FileNotFoundError:
+            return None
 
 
 @dataclass
@@ -204,6 +223,9 @@ class UnreliableBlob(Blob):
     def list_keys(self, prefix=""):
         self._check("list")
         return self.inner.list_keys(prefix)
+
+    def stat_mtime(self, key):
+        return self.inner.stat_mtime(key)
 
 
 class UnreliableConsensus(Consensus):

@@ -62,6 +62,10 @@ class ShardState:
     upper: int = 0
     batches: list = field(default_factory=list)  # list[HollowBatch]
     epoch: int = 0  # writer generation; lower-epoch writers are fenced
+    # leased readers: reader_id -> [since_hold, lease_expiry_unix_secs].
+    # The shard's effective since never passes an unexpired hold (reference:
+    # ReadHandle leases + SinceHandle, src/persist-client/src/read.rs).
+    readers: dict = field(default_factory=dict)
 
     def encode(self) -> bytes:
         return json.dumps(
@@ -72,6 +76,7 @@ class ShardState:
                     [b.key, b.lower, b.upper, b.count] for b in self.batches
                 ],
                 "epoch": self.epoch,
+                "readers": self.readers,
             }
         ).encode()
 
@@ -83,7 +88,12 @@ class ShardState:
             upper=doc["upper"],
             batches=[HollowBatch(*b) for b in doc["batches"]],
             epoch=doc.get("epoch", 0),
+            readers=doc.get("readers", {}),
         )
+
+    def min_unexpired_hold(self, now: float) -> Optional[int]:
+        holds = [h for h, exp in self.readers.values() if exp > now]
+        return min(holds) if holds else None
 
 
 def encode_columns(cols: dict) -> bytes:
@@ -125,7 +135,9 @@ class ShardMachine:
             seqno, state = self.fetch_state()
             if state.epoch > epoch:
                 raise Fenced(epoch, state.epoch)
-            new = ShardState(state.since, state.upper, state.batches, epoch)
+            new = ShardState(
+                state.since, state.upper, state.batches, epoch, state.readers
+            )
             if self.consensus.compare_and_set(self._key, seqno, new.encode()):
                 return
         raise RuntimeError("fence: CAS contention")
@@ -157,22 +169,36 @@ class ShardMachine:
         if n:
             payload_key = f"batch/{self.shard_id}/{uuid.uuid4().hex}"
             self.blob.set(payload_key, encode_columns(cols))
-        for _ in range(max_retries):
-            seqno, state = self.fetch_state()
-            if epoch is not None and state.epoch > epoch:
-                raise Fenced(epoch, state.epoch)
-            if state.upper != lower:
-                raise UpperMismatch(lower, state.upper)
-            new = ShardState(
-                since=state.since,
-                upper=upper,
-                batches=list(state.batches)
-                + ([HollowBatch(payload_key, lower, upper, n)] if n else []),
-                epoch=state.epoch,
-            )
-            if self.consensus.compare_and_set(self._key, seqno, new.encode()):
-                return
-        raise RuntimeError("compare_and_append: CAS contention exhausted retries")
+        try:
+            for _ in range(max_retries):
+                seqno, state = self.fetch_state()
+                if epoch is not None and state.epoch > epoch:
+                    raise Fenced(epoch, state.epoch)
+                if state.upper != lower:
+                    raise UpperMismatch(lower, state.upper)
+                new = ShardState(
+                    since=state.since,
+                    upper=upper,
+                    batches=list(state.batches)
+                    + ([HollowBatch(payload_key, lower, upper, n)] if n else []),
+                    epoch=state.epoch,
+                    readers=state.readers,
+                )
+                if self.consensus.compare_and_set(self._key, seqno, new.encode()):
+                    return
+            raise RuntimeError("compare_and_append: CAS contention exhausted retries")
+        except Exception:
+            # the payload was uploaded before the CAS; on a definitive loss
+            # clean it up so failed writes don't leak blobs (crash-orphans
+            # are swept by gc()). Exception only — an async KeyboardInterrupt
+            # could land after a SUCCESSFUL CAS, and deleting then would
+            # orphan a committed manifest reference (data loss)
+            if payload_key is not None:
+                try:
+                    self.blob.delete(payload_key)
+                except Exception:
+                    pass
+            raise
 
     # -- reads ----------------------------------------------------------------
     def snapshot(self, as_of: int) -> list[dict]:
@@ -212,17 +238,113 @@ class ShardMachine:
                     out.append({k: (v[mask] if not mask.all() else v) for k, v in cols.items()})
         return out, state.upper
 
-    # -- maintenance -----------------------------------------------------------
-    def downgrade_since(self, since: int, max_retries: int = 8) -> None:
+    # -- leased readers --------------------------------------------------------
+    def register_reader(
+        self, reader_id: str, lease_secs: float = 300.0, max_retries: int = 8
+    ) -> int:
+        """Acquire a since hold at the shard's current since.
+
+        Until the lease expires (or the reader downgrades/expires), compaction
+        cannot advance since past the hold — a registered reader's snapshots
+        and listens stay definite (reference: leased ReadHandle,
+        src/persist-client/src/read.rs)."""
+        import time as _time
+
         for _ in range(max_retries):
             seqno, state = self.fetch_state()
+            readers = dict(state.readers)
+            readers[reader_id] = [state.since, _time.time() + lease_secs]
             new = ShardState(
-                since=max(state.since, since), upper=state.upper,
-                batches=state.batches, epoch=state.epoch,
+                state.since, state.upper, state.batches, state.epoch, readers
+            )
+            if self.consensus.compare_and_set(self._key, seqno, new.encode()):
+                return state.since
+        raise RuntimeError("register_reader: CAS contention")
+
+    def reader_downgrade(
+        self, reader_id: str, since: int, lease_secs: float = 300.0,
+        max_retries: int = 8,
+    ) -> None:
+        """Advance a reader's hold (and renew its lease)."""
+        import time as _time
+
+        for _ in range(max_retries):
+            seqno, state = self.fetch_state()
+            if reader_id not in state.readers:
+                raise KeyError(f"reader {reader_id} not registered (lease expired?)")
+            readers = dict(state.readers)
+            hold, _exp = readers[reader_id]
+            readers[reader_id] = [max(hold, since), _time.time() + lease_secs]
+            new = ShardState(
+                state.since, state.upper, state.batches, state.epoch, readers
+            )
+            if self.consensus.compare_and_set(self._key, seqno, new.encode()):
+                return
+        raise RuntimeError("reader_downgrade: CAS contention")
+
+    def expire_reader(self, reader_id: str, max_retries: int = 8) -> None:
+        """Drop a reader's hold explicitly (clean shutdown)."""
+        for _ in range(max_retries):
+            seqno, state = self.fetch_state()
+            if reader_id not in state.readers:
+                return
+            readers = {k: v for k, v in state.readers.items() if k != reader_id}
+            new = ShardState(
+                state.since, state.upper, state.batches, state.epoch, readers
+            )
+            if self.consensus.compare_and_set(self._key, seqno, new.encode()):
+                return
+        raise RuntimeError("expire_reader: CAS contention")
+
+    # -- maintenance -----------------------------------------------------------
+    def downgrade_since(self, since: int, max_retries: int = 8) -> None:
+        """Advance the compaction frontier, capped by unexpired reader holds."""
+        import time as _time
+
+        for _ in range(max_retries):
+            seqno, state = self.fetch_state()
+            now = _time.time()
+            hold = state.min_unexpired_hold(now)
+            capped = since if hold is None else min(since, hold)
+            # expired leases are swept here (the maintenance path), so an
+            # abandoned reader only blocks compaction for its lease duration
+            readers = {
+                k: v for k, v in state.readers.items() if v[1] > now
+            }
+            new = ShardState(
+                since=max(state.since, capped), upper=state.upper,
+                batches=state.batches, epoch=state.epoch, readers=readers,
             )
             if self.consensus.compare_and_set(self._key, seqno, new.encode()):
                 return
         raise RuntimeError("downgrade_since: CAS contention")
+
+    def gc(self, grace_secs: float = 300.0) -> int:
+        """Delete orphaned batch blobs not referenced by the manifest.
+
+        Orphans arise from crashes between blob upload and CAS (normal CAS
+        losses self-clean in compare_and_append/compact). A grace period
+        protects in-flight writers that uploaded but haven't CAS'd yet
+        (reference: persist GC is seqno-scoped, internal/gc.rs; wall-clock
+        grace is the single-node analogue). Returns deleted count."""
+        import time as _time
+
+        _seq, state = self.fetch_state()
+        live = {b.key for b in state.batches}
+        now = _time.time()
+        deleted = 0
+        for key in self.blob.list_keys(f"batch/{self.shard_id}/"):
+            if key in live:
+                continue
+            mtime = self.blob.stat_mtime(key)
+            if mtime is None or now - mtime < grace_secs:
+                # unknown age counts as inside the grace period: deleting a
+                # blob an in-flight writer just uploaded (pre-CAS) would turn
+                # a successful append into silent data loss
+                continue
+            self.blob.delete(key)
+            deleted += 1
+        return deleted
 
     def compact(self) -> None:
         """Merge all batches ≤ since into one consolidated batch (reference:
@@ -260,6 +382,7 @@ class ShardMachine:
             upper=state.upper,
             batches=keep + ([HollowBatch(new_key, lower, upper, n)] if n else []),
             epoch=state.epoch,
+            readers=state.readers,
         )
         if self.consensus.compare_and_set(self._key, seqno, new_state.encode()):
             for b in mergeable:

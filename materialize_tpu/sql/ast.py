@@ -60,11 +60,27 @@ class BinaryOp:
 
 
 @dataclass(frozen=True)
+class Param:
+    """$n parameter placeholder (extended-protocol prepared statements)."""
+
+    index: int  # 1-based
+
+
+@dataclass(frozen=True)
+class WindowSpec:
+    """OVER ( [PARTITION BY exprs] [ORDER BY items] )."""
+
+    partition_by: tuple = ()
+    order_by: tuple = ()  # of OrderByItem
+
+
+@dataclass(frozen=True)
 class FuncCall:
     name: str
     args: tuple
     distinct: bool = False
     is_star: bool = False  # count(*)
+    over: Optional[Any] = None  # WindowSpec → this is a window function call
 
 
 @dataclass(frozen=True)

@@ -29,6 +29,7 @@ KEYWORDS = {
     "tables", "columns", "indexes", "sources", "views", "nulls", "first",
     "last", "date", "interval", "default", "if", "scale", "factor", "cluster",
     "replicas", "replica", "size", "set", "alter", "system", "update",
+    "over", "partition",
 }
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||", "::"}
@@ -88,6 +89,13 @@ def lex(sql: str) -> list[Token]:
                 j += 1
             word = sql[i:j].lower()
             toks.append(Token("KW" if word in KEYWORDS else "IDENT", word, i))
+            i = j
+            continue
+        if c == "$" and i + 1 < n and sql[i + 1].isdigit():
+            j = i + 1
+            while j < n and sql[j].isdigit():
+                j += 1
+            toks.append(Token("PARAM", sql[i + 1 : j], i))
             i = j
             continue
         two = sql[i : i + 2]

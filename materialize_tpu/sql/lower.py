@@ -64,6 +64,11 @@ class Lowerer:
             return tuple(out)
         if isinstance(e, mir.MirTopK):
             return self.dtypes(e.input)
+        if isinstance(e, mir.MirWindow):
+            base = self.dtypes(e.input)
+            return tuple(base) + tuple(
+                _window_out_dtype(f, base) for f in e.funcs
+            )
         if isinstance(e, (mir.MirNegate, mir.MirThreshold, mir.MirDistinct)):
             return self.dtypes(e.input)
         if isinstance(e, mir.MirUnion):
@@ -163,6 +168,28 @@ class Lowerer:
                     nulls_last=e.nulls_last,
                 ),
                 monotonic=is_monotonic(e.input, self.mono_ids),
+            )
+        if isinstance(e, mir.MirWindow):
+            from ..ops.window import WindowFuncSpec, WindowPlan
+
+            base = self.dtypes(e.input)
+            funcs = tuple(
+                WindowFuncSpec(
+                    func=f.func,
+                    arg=f.arg,
+                    offset=f.offset,
+                    out_dtype=_window_out_dtype(f, base).name,
+                )
+                for f in e.funcs
+            )
+            return lir.Window(
+                self.lower(e.input),
+                WindowPlan(
+                    partition_cols=tuple(e.partition_cols),
+                    order_by=tuple(e.order_by),
+                    funcs=funcs,
+                    nulls_last=e.nulls_last,
+                ),
             )
         if isinstance(e, mir.MirNegate):
             return lir.Negate(self.lower(e.input))
@@ -351,6 +378,18 @@ class Lowerer:
             plan=lir.LinearJoinPlan(stages=tuple(stages)),
             closure=b.finish(),
         )
+
+
+def _window_out_dtype(f, in_dtypes) -> np.dtype:
+    """np dtype of one window function's output column."""
+    if f.func in ("row_number", "rank", "dense_rank", "ntile", "count"):
+        return I64
+    dt = np.dtype(in_dtypes[f.arg])
+    if dt == np.bool_:
+        dt = np.dtype(np.int8)
+    if f.func == "sum":
+        return F32 if dt == F32 else I64
+    return dt
 
 
 def _expr_np_dtype(expr, col_dtypes):

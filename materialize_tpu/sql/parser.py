@@ -356,22 +356,7 @@ class Parser:
         order_by = []
         if self.eat_kw("order"):
             self.expect_kw("by")
-            while True:
-                e = self.parse_expr()
-                desc = False
-                if self.eat_kw("desc"):
-                    desc = True
-                elif self.eat_kw("asc"):
-                    pass
-                nulls_last = None
-                if self.eat_kw("nulls"):
-                    pos = self.ident().lower()
-                    if pos not in ("first", "last"):
-                        raise ParseError(f"expected FIRST or LAST after NULLS, got {pos}")
-                    nulls_last = pos == "last"
-                order_by.append(ast.OrderByItem(e, desc, nulls_last))
-                if not self.eat_op(","):
-                    break
+            order_by = self.parse_order_items()
         limit = None
         offset = 0
         if self.eat_kw("limit"):
@@ -381,6 +366,45 @@ class Parser:
         return ast.Query(
             body, tuple(order_by), limit, offset, tuple(ctes), recursive
         )
+
+    def parse_order_items(self) -> list:
+        """Comma list of `expr [ASC|DESC] [NULLS FIRST|LAST]` items."""
+        out = []
+        while True:
+            e = self.parse_expr()
+            desc = False
+            if self.eat_kw("desc"):
+                desc = True
+            elif self.eat_kw("asc"):
+                pass
+            nulls_last = None
+            if self.eat_kw("nulls"):
+                pos = self.ident().lower()
+                if pos not in ("first", "last"):
+                    raise ParseError(f"expected FIRST or LAST after NULLS, got {pos}")
+                nulls_last = pos == "last"
+            out.append(ast.OrderByItem(e, desc, nulls_last))
+            if not self.eat_op(","):
+                break
+        return out
+
+    def parse_over(self):
+        """`OVER ( [PARTITION BY exprs] [ORDER BY items] )` if present, else None."""
+        if not self.eat_kw("over"):
+            return None
+        self.expect_op("(")
+        partition_by = []
+        if self.eat_kw("partition"):
+            self.expect_kw("by")
+            partition_by.append(self.parse_expr())
+            while self.eat_op(","):
+                partition_by.append(self.parse_expr())
+        order_by = []
+        if self.eat_kw("order"):
+            self.expect_kw("by")
+            order_by = self.parse_order_items()
+        self.expect_op(")")
+        return ast.WindowSpec(tuple(partition_by), tuple(order_by))
 
     def parse_set_expr(self):
         left = self.parse_select_core()
@@ -698,6 +722,9 @@ class Parser:
             e = self.parse_expr()
             self.expect_op(")")
             return e
+        if t.kind == "PARAM":
+            self.next()
+            return ast.Param(int(t.value))
         if t.kind in ("IDENT", "KW"):
             name = self.ident()
             if self.at_op("("):  # function call
@@ -706,14 +733,16 @@ class Parser:
                 if self.at_op("*"):
                     self.next()
                     self.expect_op(")")
-                    return ast.FuncCall(name, (), is_star=True)
+                    return ast.FuncCall(
+                        name, (), is_star=True, over=self.parse_over()
+                    )
                 args = []
                 if not self.at_op(")"):
                     args.append(self.parse_expr())
                     while self.eat_op(","):
                         args.append(self.parse_expr())
                 self.expect_op(")")
-                return ast.FuncCall(name, tuple(args), distinct)
+                return ast.FuncCall(name, tuple(args), distinct, over=self.parse_over())
             if self.at_op(".") and self.peek(1).kind in ("IDENT", "KW"):
                 self.next()
                 col = self.ident()

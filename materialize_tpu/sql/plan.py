@@ -120,6 +120,37 @@ class _AggRef:
     index: int
 
 
+# functions that only exist as window functions (aggregates become window
+# functions when called with OVER)
+_WINDOW_FUNCS = {
+    "row_number", "rank", "dense_rank", "ntile",
+    "lag", "lead", "first_value", "last_value",
+}
+
+
+@dataclass(frozen=True)
+class _WinRef:
+    """Internal AST placeholder for an extracted window function call."""
+
+    index: int
+
+
+def _map_window_spec(spec, fn):
+    """Apply `fn` to every expression inside an OVER spec (None-safe)."""
+    if spec is None:
+        return None
+    return ast.WindowSpec(
+        tuple(fn(p) for p in spec.partition_by),
+        tuple(replace(o, expr=fn(o.expr)) for o in spec.order_by),
+    )
+
+
+def _literal_int(e, what: str) -> int:
+    if isinstance(e, ast.NumberLit) and "." not in e.value:
+        return int(e.value)
+    raise PlanError(f"{what} must be an integer literal")
+
+
 def _rescale(e, from_scale: int, to_scale: int):
     if from_scale == to_scale:
         return e
@@ -133,6 +164,13 @@ class Planner:
         self.catalog = catalog
         self._cte_frames: list[dict] = []  # name -> ("cte", PlannedQuery) | ("rec", gid, Scope)
         self._rec_counter = 0
+        # extended-protocol parameter values for the statement being planned
+        # (text-format Python values: str | None), set via set_params()
+        self._params: tuple | None = None
+
+    def set_params(self, params) -> None:
+        """Bind $n parameter values (tuple of str|None) for subsequent plans."""
+        self._params = tuple(params) if params is not None else None
 
     def _lookup_cte(self, name: str):
         for frame in reversed(self._cte_frames):
@@ -145,6 +183,8 @@ class Planner:
         """AST expr → (ScalarExpr, PType)."""
         if isinstance(e, _AggRef):
             raise PlanError("aggregate not allowed here")
+        if isinstance(e, _WinRef):
+            raise PlanError("window functions are only allowed in SELECT items")
         if isinstance(e, _PostCol):
             return Column(e.index), scope.cols[e.index].typ
         if isinstance(e, _PostSum):
@@ -175,6 +215,36 @@ class Planner:
             if e.sqrt:
                 return CallUnary("sqrt", var), FLOAT
             return var, FLOAT
+        if isinstance(e, ast.Param):
+            if self._params is None or not (1 <= e.index <= len(self._params)):
+                raise PlanError(f"parameter ${e.index} not bound")
+            v = self._params[e.index - 1]
+            # text-protocol values are typed structurally, never spliced back
+            # into SQL text (the round-1 re-literalizing shim is gone).
+            # Known limitation: a digits-only value bound against a TEXT
+            # column types as INT (pg infers parameter types from context;
+            # this planner does not yet)
+            if v is None:
+                return Literal(None), INT
+            import re as _re
+
+            if _re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
+                from ..storage.generator import date_num
+
+                y, mo, d = (int(x) for x in v.split("-"))
+                return Literal(int(date_num(y, mo, d))), DATE
+            s = v.lstrip("+")
+            if _re.fullmatch(r"-?\d+", s):
+                return Literal(int(s)), INT
+            m = _re.fullmatch(r"-?(\d*)\.(\d+)", s)
+            if m:
+                scale = len(m.group(2))
+                neg = s.startswith("-")
+                iv = int(m.group(1) or "0") * 10**scale + int(m.group(2))
+                return Literal(-iv if neg else iv), PType(ColType.NUMERIC, scale)
+            if v.lower() in ("t", "true", "f", "false"):
+                return Literal(v.lower() in ("t", "true"), "bool"), BOOL
+            return Literal(self.catalog.dict.encode(v)), STRING
         if isinstance(e, ast.Ident):
             i = scope.resolve(e.name, e.qualifier)
             return Column(i), scope.cols[i].typ
@@ -346,6 +416,10 @@ class Planner:
 
     def _plan_func(self, e: ast.FuncCall, scope: Scope):
         name = e.name
+        if e.over is not None:
+            raise PlanError("window functions are only allowed in SELECT items")
+        if name in _WINDOW_FUNCS:
+            raise PlanError(f"window function {name} requires an OVER clause")
         if name in _AGG_FUNCS:
             raise PlanError(f"aggregate {name} not allowed in this context")
         if name == "abs":
@@ -688,6 +762,19 @@ class Planner:
             p, _ = self.plan_scalar(having, scope)
             rel = mir.MirFilter(rel, (p,))
 
+        # 3.5 window functions (evaluated after grouping/HAVING, pg order)
+        wins: list[ast.FuncCall] = []
+        items = [
+            ast.SelectItem(self._extract_windows(it.expr, wins), it.alias)
+            for it in items
+        ]
+        if wins:
+            rel, scope = self._plan_windows(rel, scope, wins)
+            items = [
+                ast.SelectItem(self._rewrite_wins(it.expr), it.alias)
+                for it in items
+            ]
+
         # 4. projection (names come from the pre-rewrite select items)
         out_exprs = []
         out_cols = []
@@ -993,7 +1080,7 @@ class Planner:
         """Replace aggregate FuncCalls with _AggRef placeholders."""
         if e is None or isinstance(e, (ast.NumberLit, ast.StringLit, ast.BoolLit, ast.NullLit, ast.DateLit, ast.Ident, ast.Star)):
             return e
-        if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS:
+        if isinstance(e, ast.FuncCall) and e.name in _AGG_FUNCS and e.over is None:
             for i, a in enumerate(aggs):
                 if a == e:
                     return _AggRef(i)
@@ -1008,7 +1095,13 @@ class Planner:
                 right=self._extract_aggs(e.right, aggs),
             )
         if isinstance(e, ast.FuncCall):
-            return replace(e, args=tuple(self._extract_aggs(a, aggs) for a in e.args))
+            # window calls: aggregates may appear in args AND in the OVER
+            # spec's partition/order expressions of a grouped query
+            return replace(
+                e,
+                args=tuple(self._extract_aggs(a, aggs) for a in e.args),
+                over=_map_window_spec(e.over, lambda a: self._extract_aggs(a, aggs)),
+            )
         if isinstance(e, ast.Cast):
             return replace(e, expr=self._extract_aggs(e.expr, aggs))
         if isinstance(e, ast.Case):
@@ -1035,6 +1128,236 @@ class Planner:
             )
         if isinstance(e, ast.IsNull):
             return replace(e, expr=self._extract_aggs(e.expr, aggs))
+        return e
+
+    def _extract_windows(self, e, wins: list):
+        """Replace window FuncCalls (over != None) with _WinRef placeholders."""
+        if e is None or isinstance(
+            e,
+            (
+                ast.NumberLit, ast.StringLit, ast.BoolLit, ast.NullLit,
+                ast.DateLit, ast.Ident, ast.Star,
+                _PostCol, _PostAvg, _PostSum, _PostStat,
+            ),
+        ):
+            return e
+        if isinstance(e, ast.FuncCall) and e.over is not None:
+            for i, w in enumerate(wins):
+                if w == e:
+                    return _WinRef(i)
+            wins.append(e)
+            return _WinRef(len(wins) - 1)
+        if isinstance(e, ast.UnaryOp):
+            return replace(e, expr=self._extract_windows(e.expr, wins))
+        if isinstance(e, ast.BinaryOp):
+            return replace(
+                e,
+                left=self._extract_windows(e.left, wins),
+                right=self._extract_windows(e.right, wins),
+            )
+        if isinstance(e, ast.FuncCall):
+            return replace(
+                e, args=tuple(self._extract_windows(a, wins) for a in e.args)
+            )
+        if isinstance(e, ast.Cast):
+            return replace(e, expr=self._extract_windows(e.expr, wins))
+        if isinstance(e, ast.Case):
+            return ast.Case(
+                self._extract_windows(e.operand, wins) if e.operand else None,
+                tuple(
+                    (self._extract_windows(c, wins), self._extract_windows(r, wins))
+                    for c, r in e.whens
+                ),
+                self._extract_windows(e.else_, wins) if e.else_ else None,
+            )
+        if isinstance(e, ast.Between):
+            return replace(
+                e,
+                expr=self._extract_windows(e.expr, wins),
+                low=self._extract_windows(e.low, wins),
+                high=self._extract_windows(e.high, wins),
+            )
+        if isinstance(e, ast.InList):
+            return replace(
+                e,
+                expr=self._extract_windows(e.expr, wins),
+                items=tuple(self._extract_windows(i, wins) for i in e.items),
+            )
+        if isinstance(e, ast.IsNull):
+            return replace(e, expr=self._extract_windows(e.expr, wins))
+        return e
+
+    def _plan_windows(self, rel, scope, wins: list):
+        """Plan extracted window calls: per distinct OVER spec, map the
+        partition/order/argument expressions onto the relation and add one
+        MirWindow; finally project away the helper columns, keeping the
+        original scope plus one output column per call.
+
+        The reference plans window functions into whole-group-recompute
+        reduces during HIR lowering (src/sql/src/plan/query.rs window
+        planning, src/sql/src/plan/lowering.rs:1581); the net SQL surface
+        here is the same, the physical plan is the batched Window operator.
+        """
+        n0 = len(scope.cols)
+        groups: list[tuple] = []  # (WindowSpec, [win index, ...])
+        for i, w in enumerate(wins):
+            for spec, idxs in groups:
+                if spec == w.over:
+                    idxs.append(i)
+                    break
+            else:
+                groups.append((w.over, [i]))
+
+        cur = n0
+        func_abs: list[int] = []  # absolute column position per emitted func
+        func_types: list = []
+        self._win_repl = {}
+        pending: list[tuple] = []  # (win_i, kind, payload into func index space)
+
+        for spec, idxs in groups:
+            map_exprs: list = []
+            if spec.partition_by:
+                for p in spec.partition_by:
+                    pe, _pt = self.plan_scalar(p, scope)
+                    map_exprs.append(pe)
+            else:
+                map_exprs.append(Literal(1))
+            npart = len(map_exprs)
+            part_cols = tuple(range(cur, cur + npart))
+            for o in spec.order_by:
+                oe, _ot = self.plan_scalar(o.expr, scope)
+                map_exprs.append(oe)
+            ord_cols = tuple(range(cur + npart, cur + npart + len(spec.order_by)))
+            order_by = tuple(
+                (c, o.desc) for c, o in zip(ord_cols, spec.order_by)
+            )
+            nulls_last = (
+                tuple(
+                    (not o.desc) if o.nulls_last is None else o.nulls_last
+                    for o in spec.order_by
+                )
+                or None
+            )
+
+            funcs: list = []
+            k0 = len(func_abs)
+            for wi in idxs:
+                call = wins[wi]
+                name = call.name
+                if call.distinct:
+                    raise PlanError("DISTINCT is not supported in window functions")
+
+                def arg_col(a):
+                    v, vt = self.plan_scalar(a, scope)
+                    map_exprs.append(v)
+                    return cur + len(map_exprs) - 1, vt
+
+                if name in ("row_number", "rank", "dense_rank"):
+                    funcs.append(mir.MirWindowFunc(name))
+                    pending.append((wi, "col", (k0 + len(funcs) - 1, INT)))
+                elif name == "ntile":
+                    nt = _literal_int(call.args[0], "ntile bucket count")
+                    funcs.append(mir.MirWindowFunc("ntile", None, nt))
+                    pending.append((wi, "col", (k0 + len(funcs) - 1, INT)))
+                elif name == "count" and (call.is_star or not call.args):
+                    funcs.append(mir.MirWindowFunc("count"))
+                    pending.append((wi, "col", (k0 + len(funcs) - 1, INT)))
+                elif name == "avg":
+                    acol, vt = arg_col(call.args[0])
+                    funcs.append(mir.MirWindowFunc("sum", acol))
+                    s_k = k0 + len(funcs) - 1
+                    funcs.append(mir.MirWindowFunc("count", acol))
+                    c_k = k0 + len(funcs) - 1
+                    pending.append((wi, "avg", (s_k, c_k, vt)))
+                elif name in ("lag", "lead"):
+                    if len(call.args) >= 3:
+                        raise PlanError(f"{name} default argument not supported")
+                    acol, vt = arg_col(call.args[0])
+                    off = (
+                        _literal_int(call.args[1], f"{name} offset")
+                        if len(call.args) >= 2
+                        else 1
+                    )
+                    funcs.append(mir.MirWindowFunc(name, acol, off))
+                    pending.append((wi, "col", (k0 + len(funcs) - 1, vt)))
+                elif name in ("first_value", "last_value", "sum", "min", "max", "count"):
+                    acol, vt = arg_col(call.args[0])
+                    out_t = INT if name == "count" else vt
+                    funcs.append(mir.MirWindowFunc(name, acol))
+                    pending.append((wi, "col", (k0 + len(funcs) - 1, out_t)))
+                else:
+                    raise PlanError(f"window function {name} not supported")
+
+            rel = mir.MirMap(rel, tuple(map_exprs))
+            base = cur + len(map_exprs)
+            rel = mir.MirWindow(
+                rel, part_cols, order_by, tuple(funcs), nulls_last
+            )
+            for fi in range(len(funcs)):
+                func_abs.append(base + fi)
+            cur = base + len(funcs)
+
+        # project: original columns ++ every window output, in emission order
+        rel = mir.MirProject(rel, tuple(range(n0)) + tuple(func_abs))
+
+        # record types + placeholder replacements in projected positions
+        func_types = [None] * len(func_abs)
+        for wi, kind, payload in pending:
+            if kind == "col":
+                k, t = payload
+                func_types[k] = t
+                self._win_repl[wi] = _PostCol(n0 + k)
+            else:
+                s_k, c_k, vt = payload
+                func_types[s_k] = vt
+                func_types[c_k] = INT
+                self._win_repl[wi] = _PostAvg(n0 + s_k, n0 + c_k, vt)
+
+        out_cols = list(scope.cols) + [
+            ScopeCol(None, None, t) for t in func_types
+        ]
+        return rel, Scope(out_cols)
+
+    def _rewrite_wins(self, e):
+        """Replace _WinRef placeholders with their post-window column refs."""
+        if e is None:
+            return None
+        if isinstance(e, _WinRef):
+            return self._win_repl[e.index]
+        if isinstance(e, ast.UnaryOp):
+            return replace(e, expr=self._rewrite_wins(e.expr))
+        if isinstance(e, ast.BinaryOp):
+            return replace(
+                e, left=self._rewrite_wins(e.left), right=self._rewrite_wins(e.right)
+            )
+        if isinstance(e, ast.FuncCall):
+            return replace(e, args=tuple(self._rewrite_wins(a) for a in e.args))
+        if isinstance(e, ast.Cast):
+            return replace(e, expr=self._rewrite_wins(e.expr))
+        if isinstance(e, ast.Case):
+            return ast.Case(
+                self._rewrite_wins(e.operand) if e.operand else None,
+                tuple(
+                    (self._rewrite_wins(c), self._rewrite_wins(r))
+                    for c, r in e.whens
+                ),
+                self._rewrite_wins(e.else_) if e.else_ else None,
+            )
+        if isinstance(e, ast.Between):
+            return replace(
+                e,
+                expr=self._rewrite_wins(e.expr),
+                low=self._rewrite_wins(e.low),
+                high=self._rewrite_wins(e.high),
+            )
+        if isinstance(e, ast.InList):
+            return replace(
+                e,
+                expr=self._rewrite_wins(e.expr),
+                items=tuple(self._rewrite_wins(i) for i in e.items),
+            )
+        if isinstance(e, ast.IsNull):
+            return replace(e, expr=self._rewrite_wins(e.expr))
         return e
 
     def _plan_reduce(self, rel, scope, sel, items, aggs, having):
@@ -1167,7 +1490,11 @@ class Planner:
         if isinstance(e, ast.BinaryOp):
             return replace(e, left=self._rewrite_post(e.left), right=self._rewrite_post(e.right))
         if isinstance(e, ast.FuncCall):
-            return replace(e, args=tuple(self._rewrite_post(a) for a in e.args))
+            return replace(
+                e,
+                args=tuple(self._rewrite_post(a) for a in e.args),
+                over=_map_window_spec(e.over, self._rewrite_post),
+            )
         if isinstance(e, ast.Cast):
             return replace(e, expr=self._rewrite_post(e.expr))
         if isinstance(e, ast.Ident):

@@ -108,3 +108,127 @@ def test_unreliable_blob_fails_then_recovers():
     fail["on"] = False
     m.compare_and_append(cols([1], [0], [1]), 0, 1)  # same lower: state unchanged
     assert m.upper() == 1
+
+
+def test_leased_reader_holds_since():
+    """A registered reader's since hold caps downgrade_since until the reader
+    downgrades or its lease expires (reference: leased ReadHandle,
+    src/persist-client/src/read.rs)."""
+    m = mkshard()
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    m.compare_and_append(cols([2], [5], [1]), 1, 6)
+    hold = m.register_reader("r1", lease_secs=300.0)
+    assert hold == 0
+
+    m.downgrade_since(4)
+    assert m.since() == 0  # capped by the hold
+
+    # snapshots at the held time stay definite
+    snaps = m.snapshot(0)
+    assert sum(len(c["times"]) for c in snaps) == 1
+
+    m.reader_downgrade("r1", 3)
+    m.downgrade_since(4)
+    assert m.since() == 3  # still capped, now at the reader's new hold
+
+    m.expire_reader("r1")
+    m.downgrade_since(4)
+    assert m.since() == 4
+
+
+def test_expired_lease_unblocks_compaction():
+    m = mkshard()
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    m.register_reader("dead", lease_secs=0.0)  # instantly expired
+    import time
+
+    time.sleep(0.01)
+    m.downgrade_since(1)
+    assert m.since() == 1
+    # the expired lease was swept from state
+    _seq, state = m.fetch_state()
+    assert state.readers == {}
+
+
+def test_failed_cas_cleans_own_blob():
+    """A definitive compare_and_append loss deletes the payload it uploaded
+    (no blob leak on UpperMismatch)."""
+    m = mkshard()
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    n0 = len(m.blob.list_keys("batch/s1/"))
+    with pytest.raises(UpperMismatch):
+        m.compare_and_append(cols([2], [0], [1]), 0, 1)  # stale lower
+    assert len(m.blob.list_keys("batch/s1/")) == n0
+
+
+def test_gc_sweeps_crash_orphans():
+    """Blobs uploaded but never CAS'd (simulated crash) are swept by gc()
+    after the grace period; referenced blobs survive."""
+    m = mkshard()
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    # simulate a crash between upload and CAS: orphan payload in blob
+    from materialize_tpu.persist.shard import encode_columns
+
+    m.blob.set("batch/s1/orphan", encode_columns(cols([9], [9], [1])))
+    assert m.gc(grace_secs=3600.0) == 0  # inside grace: protected
+    assert m.gc(grace_secs=0.0) == 1  # grace elapsed: swept
+    keys = m.blob.list_keys("batch/s1/")
+    assert "batch/s1/orphan" not in keys and len(keys) == 1
+    # the shard still reads correctly
+    snaps = m.snapshot(0)
+    assert sum(len(c["times"]) for c in snaps) == 1
+
+
+def test_bounded_blobs_under_churn():
+    """compaction + gc keep the blob count bounded under append churn."""
+    m = mkshard()
+    for t in range(40):
+        m.compare_and_append(cols([t], [t], [1]), t, t + 1)
+    m.downgrade_since(39)
+    m.compact()
+    m.gc(grace_secs=0.0)
+    assert len(m.blob.list_keys("batch/s1/")) == 1
+
+
+def test_cas_race_exactly_one_winner():
+    """Two writers racing the same [lower, upper): exactly one wins, the
+    loser's payload does not leak, and no appended batch is lost."""
+    blob, cas = MemBlob(), MemConsensus()
+    w1 = ShardMachine(blob, cas, "s1")
+    w2 = ShardMachine(blob, cas, "s1")
+    w1.compare_and_append(cols([1], [0], [1]), 0, 1)
+    with pytest.raises(UpperMismatch):
+        w2.compare_and_append(cols([2], [0], [1]), 0, 1)
+    w2.compare_and_append(cols([3], [1], [1]), 1, 2)
+    snaps = w1.snapshot(1)
+    vals = sorted(int(v) for c in snaps for v in c["c0"])
+    assert vals == [1, 3]
+    assert len(blob.list_keys("batch/s1/")) == 2
+
+
+def test_unreliable_consensus_cas_crash_then_recover():
+    """Injected consensus failures mid-append leave the shard recoverable:
+    a retry after the fault either completes or reports UpperMismatch, and
+    gc bounds any leaked payloads."""
+    from materialize_tpu.persist import UnreliableConsensus
+
+    blob, cas = MemBlob(), MemConsensus()
+    fail = {"on": False}
+    ucas = UnreliableConsensus(cas, lambda op: fail["on"])
+    m = ShardMachine(blob, ucas, "s1")
+    m.compare_and_append(cols([1], [0], [1]), 0, 1)
+
+    fail["on"] = True
+    with pytest.raises(IOError):
+        m.compare_and_append(cols([2], [1], [1]), 1, 2)
+    fail["on"] = False
+
+    # the failed write did not advance the shard; a clean retry lands it
+    assert m.upper() == 1
+    m.compare_and_append(cols([2], [1], [1]), 1, 2)
+    assert m.upper() == 2
+    m.gc(grace_secs=0.0)
+    snaps = m.snapshot(1)
+    vals = sorted(int(v) for c in snaps for v in c["c0"])
+    assert vals == [1, 2]
+    assert len(blob.list_keys("batch/s1/")) == 2

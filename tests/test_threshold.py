@@ -18,7 +18,7 @@ def run(mode, ticks):
     integrated = {}
     counts = {}
     for t, (col, diffs) in enumerate(ticks):
-        state, out = threshold_step(state, mkbatch([col], [t] * len(diffs), diffs), mode, t)
+        state, out, _coll = threshold_step(state, mkbatch([col], [t] * len(diffs), diffs), mode, t)
         for data, _tt, d in out.to_rows():
             integrated[data] = integrated.get(data, 0) + d
         for v, d in zip(col, diffs):

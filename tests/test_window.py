@@ -1,0 +1,263 @@
+"""Window functions: ranking, offsets, running aggregates, incremental
+maintenance. Mirrors the reference's window-function surface
+(src/expr/src/relation/func.rs:1963 RowNumber/Rank/DenseRank/LagLead) via the
+batched affected-partition Window operator (ops/window.py)."""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+
+
+@pytest.fixture
+def coord():
+    return Coordinator()
+
+
+@pytest.fixture
+def emp(coord):
+    coord.execute("CREATE TABLE emp (dept int, name int, sal int)")
+    coord.execute(
+        "INSERT INTO emp VALUES (1, 101, 50), (1, 102, 70), (1, 103, 70),"
+        " (2, 201, 40), (2, 202, 60)"
+    )
+    return coord
+
+
+def test_row_number(emp):
+    r = emp.execute(
+        "SELECT dept, name, row_number() OVER (PARTITION BY dept ORDER BY sal DESC, name) AS rn"
+        " FROM emp ORDER BY dept, rn"
+    )
+    assert r.rows == [
+        (1, 102, 1), (1, 103, 2), (1, 101, 3),
+        (2, 202, 1), (2, 201, 2),
+    ]
+
+
+def test_rank_dense_rank_ties(emp):
+    r = emp.execute(
+        "SELECT name, rank() OVER (PARTITION BY dept ORDER BY sal DESC) AS rk,"
+        " dense_rank() OVER (PARTITION BY dept ORDER BY sal DESC) AS dr"
+        " FROM emp ORDER BY name"
+    )
+    assert r.rows == [
+        (101, 3, 2), (102, 1, 1), (103, 1, 1),
+        (201, 2, 2), (202, 1, 1),
+    ]
+
+
+def test_lag_lead(emp):
+    r = emp.execute(
+        "SELECT name, lag(sal) OVER (PARTITION BY dept ORDER BY name) AS prev,"
+        " lead(sal) OVER (PARTITION BY dept ORDER BY name) AS nxt"
+        " FROM emp ORDER BY name"
+    )
+    assert r.rows == [
+        (101, None, 70), (102, 50, 70), (103, 70, None),
+        (201, None, 60), (202, 40, None),
+    ]
+
+
+def test_lag_offset_2(emp):
+    r = emp.execute(
+        "SELECT name, lag(sal, 2) OVER (PARTITION BY dept ORDER BY name) AS p2"
+        " FROM emp ORDER BY name"
+    )
+    assert r.rows == [
+        (101, None), (102, None), (103, 50),
+        (201, None), (202, None),
+    ]
+
+
+def test_first_last_value(emp):
+    # default frame: last_value sees through the current row's peers
+    r = emp.execute(
+        "SELECT name, first_value(sal) OVER (PARTITION BY dept ORDER BY name) AS f,"
+        " last_value(sal) OVER (PARTITION BY dept ORDER BY name) AS l"
+        " FROM emp ORDER BY name"
+    )
+    assert r.rows == [
+        (101, 50, 50), (102, 50, 70), (103, 50, 70),
+        (201, 40, 40), (202, 40, 60),
+    ]
+
+
+def test_running_sum_and_count(emp):
+    r = emp.execute(
+        "SELECT name, sum(sal) OVER (PARTITION BY dept ORDER BY name) AS rs,"
+        " count(*) OVER (PARTITION BY dept ORDER BY name) AS rc"
+        " FROM emp ORDER BY name"
+    )
+    assert r.rows == [
+        (101, 50, 1), (102, 120, 2), (103, 190, 3),
+        (201, 40, 1), (202, 100, 2),
+    ]
+
+
+def test_running_sum_peers_share_frame(coord):
+    # equal ORDER BY values are peers: RANGE frame includes all of them
+    coord.execute("CREATE TABLE t (k int, v int)")
+    coord.execute("INSERT INTO t VALUES (1, 10), (1, 20), (2, 30)")
+    r = coord.execute(
+        "SELECT k, v, sum(v) OVER (ORDER BY k) AS rs FROM t ORDER BY k, v"
+    )
+    assert r.rows == [(1, 10, 30), (1, 20, 30), (2, 30, 60)]
+
+
+def test_whole_partition_agg_no_order(emp):
+    r = emp.execute(
+        "SELECT name, sum(sal) OVER (PARTITION BY dept) AS tot,"
+        " max(sal) OVER (PARTITION BY dept) AS mx,"
+        " min(sal) OVER (PARTITION BY dept) AS mn"
+        " FROM emp ORDER BY name"
+    )
+    assert r.rows == [
+        (101, 190, 70, 50), (102, 190, 70, 50), (103, 190, 70, 50),
+        (201, 100, 60, 40), (202, 100, 60, 40),
+    ]
+
+
+def test_running_min_max(emp):
+    r = emp.execute(
+        "SELECT name, min(sal) OVER (PARTITION BY dept ORDER BY name) AS mn,"
+        " max(sal) OVER (PARTITION BY dept ORDER BY name) AS mx"
+        " FROM emp ORDER BY name"
+    )
+    assert r.rows == [
+        (101, 50, 50), (102, 50, 70), (103, 50, 70),
+        (201, 40, 40), (202, 40, 60),
+    ]
+
+
+def test_avg_window(emp):
+    r = emp.execute(
+        "SELECT name, avg(sal) OVER (PARTITION BY dept) AS a FROM emp"
+        " ORDER BY name"
+    )
+    rows = [(n, round(a, 4)) for n, a in r.rows]
+    assert rows == [
+        (101, round(190 / 3, 4)), (102, round(190 / 3, 4)), (103, round(190 / 3, 4)),
+        (201, 50.0), (202, 50.0),
+    ]
+
+
+def test_ntile(coord):
+    coord.execute("CREATE TABLE t (v int)")
+    coord.execute("INSERT INTO t VALUES (1), (2), (3), (4), (5)")
+    r = coord.execute(
+        "SELECT v, ntile(2) OVER (ORDER BY v) AS b FROM t ORDER BY v"
+    )
+    assert r.rows == [(1, 1), (2, 1), (3, 1), (4, 2), (5, 2)]
+
+
+def test_window_over_empty_partition_clause(coord):
+    coord.execute("CREATE TABLE t (v int)")
+    coord.execute("INSERT INTO t VALUES (3), (1), (2)")
+    r = coord.execute(
+        "SELECT v, row_number() OVER (ORDER BY v) AS rn FROM t ORDER BY v"
+    )
+    assert r.rows == [(1, 1), (2, 2), (3, 3)]
+
+
+def test_window_nulls_order_and_aggregates(coord):
+    coord.execute("CREATE TABLE t (k int, v int)")
+    coord.execute("INSERT INTO t VALUES (1, NULL), (1, 10), (1, 20), (2, NULL)")
+    # NULLS LAST default ascending; sum/count/min/max skip NULL inputs;
+    # all-NULL partition yields NULL sum and 0 count
+    r = coord.execute(
+        "SELECT k, v, sum(v) OVER (PARTITION BY k) AS s,"
+        " count(v) OVER (PARTITION BY k) AS c FROM t ORDER BY k, v"
+    )
+    assert r.rows == [
+        (1, 10, 30, 2), (1, 20, 30, 2), (1, None, 30, 2),
+        (2, None, None, 0),
+    ]
+
+
+def test_window_lag_null_vs_missing(coord):
+    # lag over a NULL value returns the NULL value itself (not "missing")
+    coord.execute("CREATE TABLE t (v int, o int)")
+    coord.execute("INSERT INTO t VALUES (NULL, 1), (7, 2)")
+    r = coord.execute("SELECT o, lag(v) OVER (ORDER BY o) AS p FROM t ORDER BY o")
+    assert r.rows == [(1, None), (2, None)]
+
+
+def test_window_with_group_by(coord):
+    coord.execute("CREATE TABLE sales (region int, prod int, amt int)")
+    coord.execute(
+        "INSERT INTO sales VALUES (1, 1, 10), (1, 1, 20), (1, 2, 5),"
+        " (2, 1, 8), (2, 2, 12)"
+    )
+    r = coord.execute(
+        "SELECT region, prod, sum(amt) AS s,"
+        " rank() OVER (PARTITION BY region ORDER BY sum(amt) DESC) AS rk"
+        " FROM sales GROUP BY region, prod ORDER BY region, rk"
+    )
+    assert r.rows == [
+        (1, 1, 30, 1), (1, 2, 5, 2),
+        (2, 2, 12, 1), (2, 1, 8, 2),
+    ]
+
+
+def test_window_incremental_mv(coord):
+    coord.execute("CREATE TABLE emp (dept int, name int, sal int)")
+    coord.execute("INSERT INTO emp VALUES (1, 101, 50), (1, 102, 70)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT dept, name,"
+        " rank() OVER (PARTITION BY dept ORDER BY sal DESC) AS rk FROM emp"
+    )
+    r = coord.execute("SELECT * FROM mv ORDER BY dept, rk")
+    assert r.rows == [(1, 102, 1), (1, 101, 2)]
+    # insert shifts ranks within the partition
+    coord.execute("INSERT INTO emp VALUES (1, 103, 90), (2, 201, 10)")
+    r = coord.execute("SELECT * FROM mv ORDER BY dept, rk")
+    assert r.rows == [(1, 103, 1), (1, 102, 2), (1, 101, 3), (2, 201, 1)]
+    # delete restores
+    coord.execute("DELETE FROM emp WHERE name = 103")
+    r = coord.execute("SELECT * FROM mv ORDER BY dept, rk")
+    assert r.rows == [(1, 102, 1), (1, 101, 2), (2, 201, 1)]
+
+
+def test_window_incremental_running_sum(coord):
+    coord.execute("CREATE TABLE t (k int, o int, v int)")
+    coord.execute("INSERT INTO t VALUES (1, 1, 10), (1, 2, 20)")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT k, o,"
+        " sum(v) OVER (PARTITION BY k ORDER BY o) AS rs FROM t"
+    )
+    assert coord.execute("SELECT * FROM mv ORDER BY o").rows == [
+        (1, 1, 10), (1, 2, 30),
+    ]
+    coord.execute("INSERT INTO t VALUES (1, 0, 5)")
+    assert coord.execute("SELECT * FROM mv ORDER BY o").rows == [
+        (1, 0, 5), (1, 1, 15), (1, 2, 35),
+    ]
+    coord.execute("DELETE FROM t WHERE o = 1")
+    assert coord.execute("SELECT * FROM mv ORDER BY o").rows == [
+        (1, 0, 5), (1, 2, 25),
+    ]
+
+
+def test_window_duplicate_rows_row_number(coord):
+    # duplicate rows (multiplicity 2) get distinct row numbers
+    coord.execute("CREATE TABLE t (v int)")
+    coord.execute("INSERT INTO t VALUES (7), (7)")
+    r = coord.execute("SELECT v, row_number() OVER (ORDER BY v) AS rn FROM t ORDER BY rn")
+    assert r.rows == [(7, 1), (7, 2)]
+
+
+def test_window_expression_over_window(coord):
+    coord.execute("CREATE TABLE t (v int)")
+    coord.execute("INSERT INTO t VALUES (10), (20)")
+    r = coord.execute(
+        "SELECT v, v - lag(v) OVER (ORDER BY v) AS delta FROM t ORDER BY v"
+    )
+    assert r.rows == [(10, None), (20, 10)]
+
+
+def test_window_errors(coord):
+    coord.execute("CREATE TABLE t (v int)")
+    with pytest.raises(Exception, match="OVER"):
+        coord.execute("SELECT row_number() FROM t")
+    with pytest.raises(Exception, match="SELECT items"):
+        coord.execute("SELECT v FROM t WHERE row_number() OVER (ORDER BY v) = 1")
