@@ -156,6 +156,8 @@ class Coordinator:
             return self._create_table(stmt)
         if isinstance(stmt, ast.CreateSource):
             return self._create_source(stmt)
+        if isinstance(stmt, ast.CreateFileSource):
+            return self._create_file_source(stmt)
         if isinstance(stmt, ast.CreateView):
             return self._create_view(stmt)
         if isinstance(stmt, ast.CreateMaterializedView):
@@ -318,6 +320,95 @@ class Coordinator:
         ),
     }
 
+    def _create_file_source(self, stmt: ast.CreateFileSource) -> ExecResult:
+        """External file-tail CDC source with durable offset reclocking
+        (storage/file_source.py; remap shard per reclock.rs:277)."""
+        cols = tuple(
+            ColumnDesc(c.name, coltype_of(c.typ), nullable=True)
+            for c in stmt.columns
+        )
+        if stmt.envelope == "upsert":
+            # validate BEFORE any catalog mutation: a bad key must not leave
+            # a poisoned item that breaks every future boot
+            if not stmt.key_cols:
+                raise PlanError("ENVELOPE UPSERT requires KEY (cols)")
+            names = {c.name for c in cols}
+            for k in stmt.key_cols:
+                if k not in names:
+                    raise PlanError(f"upsert key column {k!r} is not in the column list")
+        desc = RelationDesc(cols)
+        options = (
+            ("path", stmt.path),
+            ("format", stmt.format),
+            ("envelope", stmt.envelope),
+            ("key", ",".join(stmt.key_cols)),
+        )
+        item = self.catalog.create(
+            CatalogItem(
+                stmt.name, "source", desc=desc, generator="file", options=options
+            )
+        )
+        self.storage[item.global_id] = StorageCollection(desc.dtypes)
+        self._register_file_source(item)
+        self._persist_catalog()
+        return ExecResult("status", status="CREATE SOURCE")
+
+    def _register_file_source(self, item) -> None:
+        """Instantiate the runtime poller; resume offset from the remap shard
+        and rebuild upsert state from the rehydrated collection."""
+        from ..storage.file_source import FileSourceSpec, FileTailSource
+        from ..storage.upsert import UpsertState
+
+        opts = dict(item.options)
+        spec = FileSourceSpec(
+            path=opts["path"],
+            fmt=opts["format"],
+            col_names=tuple(c.name for c in item.desc.columns),
+            envelope=opts.get("envelope", "none"),
+            key_cols=tuple(k for k in opts.get("key", "").split(",") if k),
+        )
+        src = FileTailSource(spec)
+        gid = item.global_id
+        if self.durable:
+            # the remap shard's last binding is the resume point: offsets
+            # below it are already ingested (and durable via the same txn)
+            m = self._shard(f"{gid}_remap")
+            _seq, state = m.fetch_state()
+            if state.upper > 0:
+                best = 0
+                for cols_ in m.snapshot(state.upper - 1):
+                    if len(cols_.get("c0", ())):
+                        best = max(best, int(cols_["c0"].max()))
+                src.offset = best
+        upsert_state = None
+        if spec.envelope == "upsert":
+            upsert_state = UpsertState()
+            names = list(spec.col_names)
+            key_idx = [names.index(k) for k in spec.key_cols]
+            val_idx = [i for i in range(len(names)) if i not in key_idx]
+            store = self.storage.get(gid)
+            if store is not None and getattr(store, "arr", None) is not None:
+                acc: dict[tuple, int] = {}
+                for data, _t, d in store.arr.rows_host():
+                    acc[data] = acc.get(data, 0) + d
+                from ..expr.scalar import null_sentinel
+
+                def _stored(i, x):
+                    # rows_host maps float NaN (the NULL sentinel) to None;
+                    # upsert state stores raw storage values, so map it back
+                    if x is None:
+                        return null_sentinel(item.desc.columns[i].dtype)
+                    return x
+
+                for data, cnt in acc.items():
+                    if cnt > 0:
+                        k = tuple(_stored(i, data[i]) for i in key_idx)
+                        v = tuple(_stored(i, data[i]) for i in val_idx)
+                        upsert_state.state[k] = v
+        if not hasattr(self, "file_sources"):
+            self.file_sources = []
+        self.file_sources.append((src, gid, upsert_state))
+
     def _create_source(self, stmt: ast.CreateSource) -> ExecResult:
         opts = dict(stmt.options)
         if stmt.generator == "auction":
@@ -427,6 +518,10 @@ class Coordinator:
         if item is not None:
             self.storage.pop(item.global_id, None)
             self.dataflows = [d for d in self.dataflows if d[0] != item.global_id]
+            if hasattr(self, "file_sources"):
+                self.file_sources = [
+                    e for e in self.file_sources if e[1] != item.global_id
+                ]
         self._persist_catalog()
         return ExecResult("status", status=f"DROP {stmt.kind.upper()}")
 
@@ -714,6 +809,9 @@ class Coordinator:
         head = self.consensus.head("catalog")
         if head is None:
             return
+        # txn-wal recovery FIRST: a crash between a multi-shard commit's
+        # txns append and its apply must not leave data shards behind the log
+        self._txn_machine().apply_up_to(1 << 62)
         doc = pickle.loads(head.data)
         self.catalog._next_id = doc["next_id"]
         for s in doc["strings"]:
@@ -734,6 +832,8 @@ class Coordinator:
             if item.kind in ("table", "source"):
                 self.storage[item.global_id] = StorageCollection(item.desc.dtypes)
                 self._rehydrate_collection(item.global_id)
+                if item.generator == "file":
+                    self._register_file_source(item)
             elif item.kind == "view":
                 item.mir = self.planner.plan_query(item.query_ast)
             elif item.kind == "materialized_view":
@@ -820,6 +920,10 @@ class Coordinator:
         for item in self.catalog.items.values():
             if item.kind in ("table", "source", "materialized_view"):
                 self._shard(item.global_id).fence(self.epoch)
+        if self.durable:
+            # the txns shard is a commit point too: fence it so a zombie
+            # generation's multi-shard commit fails at its linearization CAS
+            self._txn_machine().txns.fence(self.epoch)
         self.deploy_state = "leader"
 
     def catch_up(self) -> int:
@@ -860,7 +964,11 @@ class Coordinator:
 
     # -- write propagation -----------------------------------------------------
     def _apply_writes(
-        self, writes: dict[str, UpdateBatch], ts: int, persist: bool = True
+        self,
+        writes: dict[str, UpdateBatch],
+        ts: int,
+        persist: bool = True,
+        extra_shards: dict | None = None,
     ) -> None:
         """Group commit: append to storage (and persist shards), then flow
         through every installed dataflow in dependency order (an MV's output
@@ -887,7 +995,19 @@ class Coordinator:
         if persist and self.durable:
             if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
                 self._persist_catalog()
-            self._persist_batches(writes, ts)
+            # base-table writes are the atomicity boundary: multi-shard
+            # statements commit through txn-wal (all-or-nothing); derived MV
+            # shards below stay direct appends — they are recomputable and
+            # self-correcting from the base shards (reference stance:
+            # txn-wal fronts tables, persist_sink self-corrects).
+            # extra_shards: raw column payloads (source remap bindings) that
+            # must commit atomically WITH the data they reclock.
+            self._persist_batches(
+                writes,
+                ts,
+                atomic=len(writes) + len(extra_shards or {}) > 1,
+                extra_shards=extra_shards,
+            )
         for gid, batch in writes.items():
             self.storage[gid].append(batch, ts)
         for mv_gid, df, src_gids in self.dataflows:
@@ -909,21 +1029,46 @@ class Coordinator:
             if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
                 self._persist_catalog()
 
-    def _persist_batches(self, batches: dict[str, UpdateBatch], ts: int) -> None:
+    def _persist_batches(
+        self,
+        batches: dict[str, UpdateBatch],
+        ts: int,
+        atomic: bool = False,
+        extra_shards: dict | None = None,
+    ) -> None:
         from ..persist import Fenced
 
+        def to_cols(batch):
+            h = batch.to_host()
+            cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
+            cols["times"] = h["times"]
+            cols["diffs"] = h["diffs"]
+            return cols
+
         try:
-            for gid, batch in batches.items():
+            all_cols = {gid: to_cols(b) for gid, b in batches.items()}
+            all_cols.update(extra_shards or {})
+            if atomic and len(all_cols) > 1:
+                # multi-shard statement: one txn-wal commit is the
+                # all-or-nothing point (persist/txn.py)
+                self._txn_machine().commit(all_cols, ts, epoch=self.epoch)
+                return
+            for gid, cols in all_cols.items():
                 m = self._shard(gid)
-                h = batch.to_host()
-                cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
-                cols["times"] = h["times"]
-                cols["diffs"] = h["diffs"]
                 lower = m.upper()
                 m.compare_and_append(cols, lower, ts + 1, epoch=self.epoch)
         except Fenced:
             self.deploy_state = "fenced"
             raise
+
+    def _txn_machine(self):
+        from ..persist import TxnsMachine
+
+        tx = getattr(self, "_txns", None)
+        if tx is None:
+            tx = self._txns = TxnsMachine(self.blob, self.consensus)
+            tx._machines = self.shards  # share ShardMachine handles
+        return tx
 
     def _drive_compaction(self, ts: int) -> None:
         """Advance `since` on dataflow state and storage arrangements, keeping
@@ -955,6 +1100,11 @@ class Coordinator:
                         m.gc()
                 except (IOError, RuntimeError):
                     pass  # best-effort; the next maintenance pass retries
+            if ts % 64 == 0:
+                try:
+                    self._txn_machine().gc()
+                except (IOError, RuntimeError):
+                    pass
 
     def advance(self, n_rows: int = 100) -> int:
         """Pull one batch from every generator source and commit it."""
@@ -972,9 +1122,147 @@ class Coordinator:
             for t, b in batches.items():
                 if t in gids:
                     writes[gids[t]] = b
+        remap, committed = self._poll_file_sources(writes, ts, n_rows)
         if writes:
-            self._apply_writes(writes, ts)
+            try:
+                self._apply_writes(writes, ts, extra_shards=remap)
+            except Exception:
+                # nothing was committed: roll the pollers back so the
+                # records are re-polled next tick (offsets/upsert state must
+                # never run ahead of the durable remap binding)
+                for src, _new_offset, backup in committed:
+                    if backup is not None:
+                        backup[0].state = backup[1]
+                raise
+            for src, new_offset, _backup in committed:
+                src.offset = new_offset
         return ts
+
+    # -- external file sources -------------------------------------------------
+    def _poll_file_sources(self, writes: dict, ts: int, max_records: int):
+        """Ingest new records from every file source into `writes`; returns
+        the remap-shard bindings to commit atomically with the data
+        (reclocking: offset ranges bind to engine timestamps exactly once,
+        reference src/storage/src/source/reclock.rs:277)."""
+        remap: dict[str, dict] = {}
+        committed: list = []  # (src, new_offset, (upsert_state, backup)|None)
+        for entry in getattr(self, "file_sources", []):
+            src, gid, upsert_state = entry
+            item = next(
+                (
+                    it
+                    for it in self.catalog.items.values()
+                    if it.global_id == gid
+                ),
+                None,
+            )
+            if item is None:
+                continue  # dropped concurrently
+            try:
+                records, new_offset = src.poll(max_records)
+            except OSError:
+                continue  # transient file trouble; retry next tick
+            if new_offset == src.offset:
+                continue
+            backup = None
+            if upsert_state is not None:
+                backup = (upsert_state, dict(upsert_state.state))
+            batch = self._decode_file_records(records, item.desc, src, upsert_state, ts)
+            if batch is not None:
+                writes[gid] = (
+                    batch
+                    if gid not in writes
+                    else UpdateBatch.concat(writes[gid], batch)
+                )
+            remap[f"{gid}_remap"] = {
+                "c0": np.array([new_offset], dtype=np.int64),
+                "times": np.full(1, ts, dtype=np.uint64),
+                "diffs": np.ones(1, dtype=np.int64),
+            }
+            committed.append((src, new_offset, backup))
+        return remap or None, committed
+
+    def _decode_file_records(self, records, desc, src, upsert_state, ts):
+        """Typed columns from decoded record dicts (the interchange layer)."""
+        if not records:
+            return None
+        spec = src.spec
+        names = [c.name for c in desc.columns]
+        if spec.envelope == "upsert":
+            key_idx = [names.index(k) for k in spec.key_cols]
+            val_idx = [i for i in range(len(names)) if i not in key_idx]
+            keys, values = [], []
+            for r in records:
+                k = tuple(
+                    self._coerce_source_value(r.get(names[i]), desc.columns[i])
+                    for i in key_idx
+                )
+                vals_present = any(r.get(names[i]) is not None for i in val_idx)
+                if not vals_present:
+                    values.append(None)  # tombstone
+                else:
+                    values.append(
+                        tuple(
+                            self._coerce_source_value(r.get(names[i]), desc.columns[i])
+                            for i in val_idx
+                        )
+                    )
+                keys.append(k)
+            # upsert emits rows as (key cols ++ val cols); reorder to desc order
+            out = upsert_state.apply(
+                keys, values, ts, len(val_idx),
+                tuple(desc.columns[i].dtype for i in key_idx),
+                tuple(desc.columns[i].dtype for i in val_idx),
+            )
+            order = key_idx + val_idx
+            inv = [order.index(i) for i in range(len(names))]
+            return UpdateBatch(
+                out.hashes, out.keys,
+                tuple(out.vals[i] for i in inv),
+                out.times, out.diffs,
+            )
+        rows, diffs = [], []
+        for r in records:
+            d = int(r.get("__diff__", 1))
+            rows.append(
+                tuple(
+                    self._coerce_source_value(r.get(n), cd)
+                    for n, cd in zip(names, desc.columns)
+                )
+            )
+            diffs.append(d)
+        cols = tuple(
+            np.array([row[i] for row in rows], dtype=desc.columns[i].dtype)
+            for i in range(len(names))
+        )
+        return UpdateBatch.build(
+            (), cols, np.full(len(rows), ts, dtype=np.uint64),
+            np.array(diffs, dtype=np.int64),
+        )
+
+    def _coerce_source_value(self, v, cdesc: ColumnDesc):
+        from ..expr.scalar import null_sentinel
+
+        if v is None:
+            return null_sentinel(cdesc.dtype)
+        if cdesc.typ == ColType.STRING:
+            return self.catalog.dict.encode(str(v))
+        if cdesc.typ == ColType.BOOL:
+            if isinstance(v, str):
+                return 1 if v.lower() in ("t", "true", "1") else 0
+            return 1 if v else 0
+        if cdesc.typ == ColType.NUMERIC:
+            from decimal import Decimal
+
+            return int(Decimal(str(v)).scaleb(cdesc.scale))
+        if cdesc.typ == ColType.FLOAT64:
+            return float(v)
+        if isinstance(v, str) and len(v) == 10 and v[4] == "-" and v[7] == "-":
+            from ..storage.generator import date_num
+
+            y, m, d = (int(x) for x in v.split("-"))
+            return int(date_num(y, m, d))
+        return int(v)
 
     # -- reads -----------------------------------------------------------------
     def _select(self, query: ast.Query) -> ExecResult:

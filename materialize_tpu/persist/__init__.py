@@ -9,8 +9,10 @@ from .location import (
     UnreliableConsensus,
 )
 from .shard import Fenced, ShardMachine, ShardState, UpperMismatch
+from .txn import TxnsMachine
 
 __all__ = [
+    "TxnsMachine",
     "Blob",
     "Consensus",
     "FileBlob",

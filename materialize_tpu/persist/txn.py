@@ -1,0 +1,220 @@
+"""txn-wal: atomic multi-shard commits through a write-ahead txns shard.
+
+The analogue of the reference's txn-wal protocol (src/txn-wal/src/lib.rs:9-47):
+writes to N data shards commit atomically by (1) uploading each data batch to
+blob, then (2) appending ONE record to the txns shard listing every
+(data shard, payload key) — that single compare_and_append is the commit
+point — and only then (3) lazily *applying* the recorded batches to the data
+shards themselves. A crash after (2) loses nothing: recovery replays
+unapplied records from the txns shard; a crash before (2) commits nothing
+(the orphaned payloads are swept by shard gc).
+
+Readers treat the TXNS shard's upper as the read frontier for every shard in
+the txn domain and call ensure_applied(ts) before snapshotting, mirroring the
+reference's data-shard read path consulting the txns shard.
+
+Txns-shard batch layout: one commit == one hollow batch whose payload columns
+are {times, diffs, recjson}; recjson carries the JSON record list
+[(shard_id, payload_key | null, n), ...] packed into int64 lanes (all columns
+share the lane count so generic column tooling stays happy). The txns shard
+is never compacted — its batches ARE the log.
+"""
+
+from __future__ import annotations
+
+import json
+import uuid
+
+import numpy as np
+
+from .location import Blob, Consensus
+from .shard import ShardMachine, UpperMismatch, decode_columns, encode_columns
+
+
+def _pack_lanes(data: bytes) -> np.ndarray:
+    pad = (-len(data)) % 8
+    return np.frombuffer(data + b"\x00" * pad, dtype="<u8").astype(np.int64)
+
+
+def _unpack_lanes(col: np.ndarray) -> bytes:
+    return np.asarray(col, dtype=np.int64).astype("<u8").tobytes().rstrip(b"\x00")
+
+
+class TxnsMachine:
+    """Coordinator of atomic writes across data shards.
+
+    One instance per (blob, consensus) environment; data shards are addressed
+    by shard_id and materialized as ShardMachines on demand.
+    """
+
+    def __init__(self, blob: Blob, consensus: Consensus, txns_id: str = "txns"):
+        self.blob = blob
+        self.consensus = consensus
+        self.txns = ShardMachine(blob, consensus, txns_id)
+        self._machines: dict[str, ShardMachine] = {}
+        # times strictly below this are known applied (in-memory fast path:
+        # keeps the hot commit path from re-reading the whole txns log —
+        # data-shard uppers remain the authoritative idempotency check)
+        self._applied_through = 0
+
+    def data_shard(self, shard_id: str) -> ShardMachine:
+        m = self._machines.get(shard_id)
+        if m is None:
+            m = self._machines[shard_id] = ShardMachine(
+                self.blob, self.consensus, shard_id
+            )
+        return m
+
+    # -- commit ----------------------------------------------------------------
+    def commit(
+        self, writes: dict[str, dict], ts: int, epoch: int | None = None
+    ) -> None:
+        """Atomically commit `writes` ({shard_id: cols}) at time ts.
+
+        The txns-shard append at [ts, ts+1) is the linearization point: once
+        it succeeds the transaction IS durable even if this process dies
+        before apply. cols may be {} for shards that only advance their upper.
+        """
+        lower = self.txns.upper()
+        if ts < lower:
+            raise UpperMismatch(ts, lower)
+        records = []
+        uploaded = []
+        try:
+            for shard_id, cols in sorted(writes.items()):
+                n = int(len(cols.get("times", ()))) if cols else 0
+                key = None
+                if n:
+                    key = f"txnbatch/{shard_id}/{uuid.uuid4().hex}"
+                    self.blob.set(key, encode_columns(cols))
+                    uploaded.append(key)
+                records.append([shard_id, key, n])
+            lanes = _pack_lanes(json.dumps(records).encode())
+            k = len(lanes)
+            self.txns.compare_and_append(
+                {
+                    "times": np.full(k, ts, dtype=np.uint64),
+                    "diffs": np.ones(k, dtype=np.int64),
+                    "recjson": lanes,
+                },
+                lower,
+                ts + 1,
+                epoch=epoch,
+            )
+        except Exception:
+            # pre-commit-point failure: nothing is durable; reclaim payloads.
+            # Exception only — an async KeyboardInterrupt could land AFTER a
+            # successful txns CAS, and deleting then would destroy payloads a
+            # durable commit references (same hazard note as shard.py)
+            for key in uploaded:
+                try:
+                    self.blob.delete(key)
+                except Exception:
+                    pass
+            raise
+        # commit point passed — apply is best-effort here, replayed on read
+        self.apply_up_to(ts + 1)
+
+    # -- apply / read ----------------------------------------------------------
+    def apply_up_to(self, upper: int) -> int:
+        """Apply every committed-but-unapplied txn record with time < upper.
+
+        Idempotent: a data shard's own upper records how far it has applied
+        (each apply advances it to record_time + 1). Fully-applied records'
+        payloads are reclaimed. Returns applied count.
+        """
+        applied = 0
+        for t, records in self._records_below(upper, min_t=self._applied_through):
+            for shard_id, key, _n in records:
+                m = self.data_shard(shard_id)
+                cur = m.upper()
+                if cur > t:
+                    continue  # already applied (or beyond)
+                cols = {}
+                if key is not None:
+                    payload = self.blob.get(key)
+                    if payload is None:
+                        # a concurrent applier finished and reclaimed the
+                        # payload; its apply advanced the shard — confirm
+                        if self.data_shard(shard_id).upper() > t:
+                            continue
+                        raise IOError(f"txn-wal: committed payload {key} missing")
+                    cols = decode_columns(payload)
+                try:
+                    m.compare_and_append(cols, cur, t + 1)
+                    applied += 1
+                except UpperMismatch as e:
+                    if e.actual <= t:
+                        raise  # shard moved backwards — state corruption
+                    # a concurrent applier won; that's success
+            # every shard of this record is now confirmed applied (each
+            # branch above either applied, found it applied, or raised):
+            # reclaim the payloads
+            for _shard_id, key, _n in records:
+                if key is not None:
+                    try:
+                        self.blob.delete(key)
+                    except Exception:
+                        pass  # gc() sweeps stragglers
+        self._applied_through = max(
+            self._applied_through, min(upper, self.txns.upper())
+        )
+        return applied
+
+    def ensure_applied(self, as_of: int) -> None:
+        """Make every data shard definite for reads at `as_of`."""
+        self.apply_up_to(as_of + 1)
+
+    def read_ts(self) -> int:
+        """Largest complete time across the txn domain."""
+        return self.txns.upper() - 1
+
+    def snapshot(self, shard_id: str, as_of: int) -> list[dict]:
+        """Definite snapshot of a data shard at as_of (applies first)."""
+        self.ensure_applied(as_of)
+        return self.data_shard(shard_id).snapshot(as_of)
+
+    def _records_below(self, upper: int, min_t: int = 0):
+        """(time, records) pairs of txn commits with min_t <= time < upper,
+        ascending. A commit batch's time is its manifest upper - 1 (commit
+        always appends [lower, ts+1)), so skipped batches cost no blob I/O."""
+        _seq, state = self.txns.fetch_state()
+        out = []
+        for b in state.batches:
+            if not b.count or b.lower >= upper or b.upper - 1 < min_t:
+                continue
+            payload = self.blob.get(b.key)
+            if payload is None:
+                raise IOError(f"txn-wal: txns batch {b.key} missing")
+            cols = decode_columns(payload)
+            t = int(cols["times"][0])
+            if t >= upper or t < min_t:
+                continue
+            out.append((t, json.loads(_unpack_lanes(cols["recjson"]).decode())))
+        out.sort(key=lambda p: p[0])
+        return out
+
+    def gc(self, grace_secs: float = 300.0) -> int:
+        """Sweep txnbatch payloads that no txns record references (crash
+        orphans from dying between upload and the commit-point CAS).
+        Referenced-but-unapplied payloads are protected by the reference
+        itself; applied payloads are reclaimed by apply_up_to. Returns the
+        deleted count."""
+        import time as _time
+
+        referenced = set()
+        for _t, records in self._records_below(1 << 62):
+            for _shard_id, key, _n in records:
+                if key is not None:
+                    referenced.add(key)
+        now = _time.time()
+        deleted = 0
+        for key in self.blob.list_keys("txnbatch/"):
+            if key in referenced:
+                continue
+            mtime = self.blob.stat_mtime(key)
+            if mtime is None or now - mtime < grace_secs:
+                continue
+            self.blob.delete(key)
+            deleted += 1
+        return deleted

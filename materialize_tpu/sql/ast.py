@@ -248,6 +248,20 @@ class CreateSource:
 
 
 @dataclass(frozen=True)
+class CreateFileSource:
+    """CREATE SOURCE name (cols) FROM FILE 'path' (FORMAT JSON|CSV)
+    [ENVELOPE UPSERT (KEY (cols))] — external CDC ingestion with durable
+    offset reclocking."""
+
+    name: str
+    columns: tuple  # ColumnDef
+    path: str
+    format: str  # json | csv
+    envelope: str = "none"
+    key_cols: tuple = ()  # column names (upsert)
+
+
+@dataclass(frozen=True)
 class CreateMaterializedView:
     name: str
     query: Query

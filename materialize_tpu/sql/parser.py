@@ -165,7 +165,21 @@ class Parser:
             return ast.CreateTable(name, tuple(cols))
         if self.eat_kw("source"):
             name = self.ident()
+            columns = []
+            if self.eat_op("("):
+                while not self.at_op(")"):
+                    cname = self.ident()
+                    ctyp = self.parse_type_name()
+                    columns.append(ast.ColumnDef(cname, ctyp))
+                    self.eat_op(",")
+                self.expect_op(")")
             self.expect_kw("from")
+            if self.peek().kind == "IDENT" and self.peek().value == "file":
+                return self._parse_file_source(name, tuple(columns))
+            if columns:
+                raise ParseError(
+                    "column lists are only supported on FILE sources"
+                )
             self.expect_kw("load")
             self.expect_kw("generator")
             gen = self.ident()
@@ -219,6 +233,47 @@ class Parser:
                 self.expect_op(")")
             return ast.CreateIndex(name, on, tuple(cols))
         raise ParseError(f"unsupported CREATE {self.peek().value!r}")
+
+    def _parse_file_source(self, name: str, columns: tuple):
+        self.next()  # 'file'
+        t = self.peek()
+        if t.kind != "STRING":
+            raise ParseError(f"expected file path string, found {t.value!r}")
+        path = self.next().value
+        fmt = "json"
+        if self.eat_op("("):
+            while not self.at_op(")"):
+                key = self.ident().lower()
+                if key == "format":
+                    fmt = self.ident().lower()
+                else:
+                    raise ParseError(f"unknown file source option {key!r}")
+                self.eat_op(",")
+            self.expect_op(")")
+        if fmt not in ("json", "csv"):
+            raise ParseError(f"unsupported file source format {fmt!r}")
+        envelope, key_cols = "none", ()
+        if self.peek().kind == "IDENT" and self.peek().value == "envelope":
+            self.next()
+            env = self.ident().lower()
+            if env != "upsert":
+                raise ParseError(f"unsupported envelope {env!r}")
+            envelope = "upsert"
+            if self.eat_op("("):
+                kw = self.ident().lower()
+                if kw != "key":
+                    raise ParseError("expected KEY (cols) in ENVELOPE UPSERT")
+                self.expect_op("(")
+                cols = []
+                while not self.at_op(")"):
+                    cols.append(self.ident())
+                    self.eat_op(",")
+                self.expect_op(")")
+                self.expect_op(")")
+                key_cols = tuple(cols)
+        if not columns:
+            raise ParseError("file sources require an explicit column list")
+        return ast.CreateFileSource(name, columns, path, fmt, envelope, key_cols)
 
     def parse_type_name(self) -> str:
         base = self.ident()

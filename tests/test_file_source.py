@@ -1,0 +1,189 @@
+"""External file-tail CDC source: ingestion, formats, envelopes, and
+exactly-once resume across an engine kill/restart (the testdrive-style
+scenario from VERDICT r1 item 4).
+
+The "external system" is a separate writer process appending records; the
+engine reclocks line offsets through a durable remap shard
+(reference: src/storage/src/source/reclock.rs:277) committed atomically with
+the data via txn-wal.
+"""
+
+import json
+import subprocess
+import sys
+import time
+
+from materialize_tpu.adapter import Coordinator
+
+
+def test_json_file_source_ingests(tmp_path):
+    p = tmp_path / "feed.jsonl"
+    p.write_text(
+        json.dumps({"id": 1, "name": "ada", "score": 9.5}) + "\n"
+        + json.dumps({"id": 2, "name": "bob", "score": None}) + "\n"
+    )
+    c = Coordinator()
+    c.execute(
+        f"CREATE SOURCE feed (id int, name text, score float) FROM FILE '{p}' (FORMAT JSON)"
+    )
+    c.advance()
+    r = c.execute("SELECT id, name, score FROM feed ORDER BY id")
+    assert r.rows[0][:2] == (1, "ada") and abs(r.rows[0][2] - 9.5) < 1e-6
+    assert r.rows[1] == (2, "bob", None)
+
+    # appended lines arrive on the next tick; a retraction via __diff__
+    with open(p, "a") as f:
+        f.write(json.dumps({"id": 3, "name": "eve", "score": 1.0}) + "\n")
+        f.write(json.dumps({"id": 1, "name": "ada", "score": 9.5, "__diff__": -1}) + "\n")
+    c.advance()
+    r = c.execute("SELECT id FROM feed ORDER BY id")
+    assert r.rows == [(2,), (3,)]
+
+
+def test_csv_file_source_and_mv(tmp_path):
+    p = tmp_path / "feed.csv"
+    p.write_text("1,x,10\n2,y,20\n")
+    c = Coordinator()
+    c.execute(
+        f"CREATE SOURCE feed (id int, tag text, amt int) FROM FILE '{p}' (FORMAT CSV)"
+    )
+    c.execute("CREATE MATERIALIZED VIEW tot AS SELECT sum(amt) AS s FROM feed")
+    c.advance()
+    assert c.execute("SELECT * FROM tot").rows == [(30,)]
+    with open(p, "a") as f:
+        f.write("3,z,5\n")
+    c.advance()
+    assert c.execute("SELECT * FROM tot").rows == [(35,)]
+
+
+def test_upsert_envelope_file_source(tmp_path):
+    p = tmp_path / "kv.jsonl"
+    lines = [
+        {"k": 1, "v": 10},
+        {"k": 2, "v": 20},
+        {"k": 1, "v": 11},  # overwrite
+        {"k": 2, "v": None},  # tombstone
+    ]
+    p.write_text("".join(json.dumps(r) + "\n" for r in lines))
+    c = Coordinator()
+    c.execute(
+        f"CREATE SOURCE kv (k int, v int) FROM FILE '{p}' (FORMAT JSON)"
+        " ENVELOPE UPSERT (KEY (k))"
+    )
+    c.advance()
+    assert c.execute("SELECT * FROM kv ORDER BY k").rows == [(1, 11)]
+
+
+def test_partial_line_not_consumed(tmp_path):
+    p = tmp_path / "feed.jsonl"
+    with open(p, "w") as f:
+        f.write(json.dumps({"id": 1}) + "\n")
+        f.write('{"id": 2')  # incomplete — writer is mid-append
+    c = Coordinator()
+    c.execute(f"CREATE SOURCE feed (id int) FROM FILE '{p}' (FORMAT JSON)")
+    c.advance()
+    assert c.execute("SELECT id FROM feed").rows == [(1,)]
+    with open(p, "a") as f:
+        f.write(', "x": 0}\n')
+    c.advance()
+    assert c.execute("SELECT id FROM feed ORDER BY id").rows == [(1,), (2,)]
+
+
+def test_exactly_once_resume_across_restart(tmp_path):
+    """Live external writer; engine killed mid-stream; restart resumes from
+    the durable remap binding — no duplicates, no gaps."""
+    p = tmp_path / "feed.jsonl"
+    d = str(tmp_path / "data")
+    writer = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            (
+                "import json, sys, time\n"
+                f"path = {str(p)!r}\n"
+                "for i in range(40):\n"
+                "    with open(path, 'a') as f:\n"
+                "        f.write(json.dumps({'id': i, 'v': i * 2}) + '\\n')\n"
+                "    time.sleep(0.05)\n"
+            ),
+        ]
+    )
+    try:
+        c1 = Coordinator(data_dir=d)
+        c1.execute(
+            f"CREATE SOURCE feed (id int, v int) FROM FILE '{p}' (FORMAT JSON)"
+        )
+        seen = 0
+        deadline = time.time() + 20
+        while seen < 10 and time.time() < deadline:
+            c1.advance()
+            seen = c1.execute("SELECT count(*) FROM feed").rows[0][0]
+            time.sleep(0.05)
+        assert seen >= 10
+        # hard kill: no checkpoint, just drop the object (durable state =
+        # shards incl. the remap binding committed with each ingest txn)
+        del c1
+
+        writer.wait(timeout=30)
+
+        c2 = Coordinator(data_dir=d)
+        before = c2.execute("SELECT count(*) FROM feed").rows[0][0]
+        assert before >= seen  # nothing ingested was lost
+        c2.advance()
+        rows = c2.execute("SELECT id FROM feed ORDER BY id").rows
+        # exactly once: all 40 ids, each exactly one row
+        assert rows == [(i,) for i in range(40)]
+    finally:
+        if writer.poll() is None:
+            writer.kill()
+
+
+def test_malformed_lines_skipped_not_wedged(tmp_path):
+    """One bad record must never wedge ingestion (dead-letter counter)."""
+    p = tmp_path / "feed.jsonl"
+    p.write_text(
+        json.dumps({"id": 1}) + "\n"
+        + "THIS IS NOT JSON\n"
+        + "[1, 2, 3]\n"
+        + json.dumps({"id": 2}) + "\n"
+    )
+    c = Coordinator()
+    c.execute(f"CREATE SOURCE feed (id int) FROM FILE '{p}' (FORMAT JSON)")
+    c.advance()
+    assert c.execute("SELECT id FROM feed ORDER BY id").rows == [(1,), (2,)]
+    src, _gid, _u = c.file_sources[0]
+    assert src.decode_errors == 2
+    # the offset moved past the bad lines: the next tick re-reads nothing
+    c.advance()
+    assert c.execute("SELECT count(*) FROM feed").rows == [(2,)]
+
+
+def test_drop_source_then_advance(tmp_path):
+    """DROP SOURCE must unregister the poller (advance() used to crash)."""
+    p = tmp_path / "feed.jsonl"
+    p.write_text(json.dumps({"id": 1}) + "\n")
+    c = Coordinator()
+    c.execute(f"CREATE SOURCE feed (id int) FROM FILE '{p}' (FORMAT JSON)")
+    c.advance()
+    c.execute("DROP SOURCE feed")
+    with open(p, "a") as f:
+        f.write(json.dumps({"id": 2}) + "\n")
+    c.advance()  # must not raise
+    assert c.file_sources == []
+
+
+def test_upsert_requires_valid_key(tmp_path):
+    import pytest
+
+    c = Coordinator()
+    with pytest.raises(Exception, match="KEY"):
+        c.execute(
+            "CREATE SOURCE s (a int, b int) FROM FILE '/tmp/x' (FORMAT JSON) ENVELOPE UPSERT"
+        )
+    with pytest.raises(Exception, match="not in the column list"):
+        c.execute(
+            "CREATE SOURCE s (a int, b int) FROM FILE '/tmp/x' (FORMAT JSON)"
+            " ENVELOPE UPSERT (KEY (zz))"
+        )
+    # the failed statements left no catalog debris
+    assert ("s",) not in c.execute("SHOW SOURCES").rows
