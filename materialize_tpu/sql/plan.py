@@ -110,6 +110,7 @@ class PlannedQuery:
 _AGG_FUNCS = {
     "sum", "count", "min", "max", "avg",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
+    "bool_and", "bool_or",
 }
 
 
@@ -1374,68 +1375,103 @@ class Planner:
                 group_asts.append(g)
         key_planned = [self.plan_scalar(g, scope) for g in group_asts]
 
-        # plan aggregate argument expressions + build MirAggregates
+        # plan aggregate argument expressions + build MirAggregates.
+        # DISTINCT aggregates get their own reduce branch over
+        # DISTINCT(keys, arg) — the reference plans them the same way
+        # (a distinct collection feeding the aggregation); branches join
+        # back on the group key below.
         mir_aggs = []
         agg_types = []
+        agg_branch: list = []  # parallel to mir_aggs: 0 = main, >0 = distinct
+        distinct_branches: list = []  # (branch_id, arg ast)
         post_agg_exprs: list = []  # how each _AggRef is reconstructed post-reduce
+
+        nk = len(group_asts)
+
+        def branch_for(a, v):
+            """(branch id, aggregate input expr). min/max/bool_and/bool_or
+            over DISTINCT inputs equal their plain forms, so they stay in the
+            main branch; other DISTINCT aggs get a dedicated branch whose
+            reduce reads the distinct relation's arg column."""
+            if not a.distinct or a.name in ("min", "max", "bool_and", "bool_or"):
+                return 0, v
+            if a.name in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+                raise PlanError(f"DISTINCT {a.name} not supported")
+            bid = len(distinct_branches) + 1
+            distinct_branches.append((bid, v))
+            return bid, Column(nk)
+
+        def emit(bid: int, agg) -> int:
+            mir_aggs.append(agg)
+            agg_branch.append(bid)
+            return len(mir_aggs) - 1
+
         for a in aggs:
             fname = a.name
-            if a.distinct:
-                raise PlanError("DISTINCT aggregates not supported yet")
             if fname == "count":
                 # count(*) counts rows; count(x) counts non-null x
                 if a.args and not isinstance(a.args[0], ast.Star):
                     arg, _at = self.plan_scalar(a.args[0], scope)
+                    bid, arg = branch_for(a, arg)
                 else:
-                    arg = Literal(1)
-                mir_aggs.append(mir.MirAggregate("count", arg))
-                post_agg_exprs.append(("col", len(mir_aggs) - 1, INT))
+                    arg, bid = Literal(1), 0
+                i = emit(bid, mir.MirAggregate("count", arg))
+                post_agg_exprs.append(("col", i, INT))
                 agg_types.append(INT)
             elif fname == "avg":
                 v, vt = self.plan_scalar(a.args[0], scope)
-                mir_aggs.append(mir.MirAggregate("sum", v))
-                sum_i = len(mir_aggs) - 1
+                bid, v = branch_for(a, v)
+                sum_i = emit(bid, mir.MirAggregate("sum", v))
                 # avg divides by the NON-NULL input count
-                mir_aggs.append(mir.MirAggregate("count", v))
-                cnt_i = len(mir_aggs) - 1
+                cnt_i = emit(bid, mir.MirAggregate("count", v))
                 post_agg_exprs.append(("avg", (sum_i, cnt_i, vt), FLOAT))
                 agg_types.extend([vt, INT])
             elif fname in ("stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop"):
+                if a.distinct:
+                    raise PlanError(f"DISTINCT {fname} not supported")
                 v, vt = self.plan_scalar(a.args[0], scope)
-                mir_aggs.append(mir.MirAggregate("sum", v))
-                sum_i = len(mir_aggs) - 1
-                mir_aggs.append(mir.MirAggregate("sum", CallBinary("mul", v, v)))
-                sq_i = len(mir_aggs) - 1
-                mir_aggs.append(mir.MirAggregate("count", Literal(1)))
-                cnt_i = len(mir_aggs) - 1
+                sum_i = emit(0, mir.MirAggregate("sum", v))
+                sq_i = emit(0, mir.MirAggregate("sum", CallBinary("mul", v, v)))
+                cnt_i = emit(0, mir.MirAggregate("count", Literal(1)))
                 sq_t = PType(ColType.NUMERIC, vt.scale * 2) if vt.col == ColType.NUMERIC else vt
                 post_agg_exprs.append((fname, (sum_i, sq_i, cnt_i, vt), FLOAT))
                 agg_types.extend([vt, sq_t, INT])
             elif fname == "sum":
                 v, vt = self.plan_scalar(a.args[0], scope)
-                mir_aggs.append(mir.MirAggregate("sum", v))
-                sum_i = len(mir_aggs) - 1
+                bid, v = branch_for(a, v)
+                sum_i = emit(bid, mir.MirAggregate("sum", v))
                 # paired non-null count: sum over only-NULL inputs is NULL
-                mir_aggs.append(mir.MirAggregate("count", v))
-                cnt_i = len(mir_aggs) - 1
+                cnt_i = emit(bid, mir.MirAggregate("count", v))
                 post_agg_exprs.append(("sumn", (sum_i, cnt_i, vt), vt))
                 agg_types.extend([vt, INT])
+            elif fname in ("bool_and", "bool_or"):
+                # all/any over non-NULL inputs = min/max over the stored
+                # int8 truth values (func.rs All/Any accumulation)
+                v, _vt = self.plan_scalar(a.args[0], scope)
+                i = emit(0, mir.MirAggregate("min" if fname == "bool_and" else "max", v))
+                post_agg_exprs.append(("col", i, BOOL))
+                agg_types.append(BOOL)
             else:
                 v, vt = self.plan_scalar(a.args[0], scope)
                 out_t = vt if fname != "count" else INT
-                mir_aggs.append(mir.MirAggregate(fname, v))
-                post_agg_exprs.append(("col", len(mir_aggs) - 1, out_t))
+                i = emit(0, mir.MirAggregate(fname, v))
+                post_agg_exprs.append(("col", i, out_t))
                 agg_types.append(out_t)
 
         # keys become mapped columns so the Reduce's group_key is plain columns
         arity_in = len(scope.cols)
         key_exprs = tuple(p for p, _ in key_planned)
-        inner = mir.MirMap(rel, key_exprs)
-        rel = mir.MirReduce(
-            inner,
-            group_key=tuple(range(arity_in, arity_in + len(key_exprs))),
-            aggregates=tuple(mir_aggs),
-        )
+        if not distinct_branches:
+            inner = mir.MirMap(rel, key_exprs)
+            rel = mir.MirReduce(
+                inner,
+                group_key=tuple(range(arity_in, arity_in + len(key_exprs))),
+                aggregates=tuple(mir_aggs),
+            )
+        else:
+            rel = self._reduce_with_distinct_branches(
+                rel, arity_in, key_exprs, mir_aggs, agg_branch, distinct_branches
+            )
 
         # post-reduce scope: keys then aggregate outputs
         post_cols = []
@@ -1458,6 +1494,62 @@ class Planner:
         ]
         having = self._rewrite_post(having) if having is not None else None
         return rel, post_scope, items, having
+
+    def _reduce_with_distinct_branches(
+        self, rel, arity_in, key_exprs, mir_aggs, agg_branch, distinct_branches
+    ):
+        """DISTINCT aggregates: one reduce per distinct argument over
+        DISTINCT(keys, arg), joined back with the main reduce on the group
+        key (NULL-safe: NULL group keys are one group). Output layout is the
+        canonical (keys ++ aggregates in declaration order) so the post-agg
+        rewrite indices stay valid. Mirrors the reference's distinct-agg
+        planning (a distinct collection feeding each such aggregate)."""
+        nk = len(key_exprs)
+        order: list[int] = []
+        per_branch: dict[int, list[int]] = {}
+        for i, b in enumerate(agg_branch):
+            per_branch.setdefault(b, []).append(i)
+        branches = []
+        if per_branch.get(0):
+            inner = mir.MirMap(rel, key_exprs)
+            branches.append(
+                mir.MirReduce(
+                    inner,
+                    group_key=tuple(range(arity_in, arity_in + nk)),
+                    aggregates=tuple(mir_aggs[i] for i in per_branch[0]),
+                )
+            )
+            order.append(0)
+        for bid, v in distinct_branches:
+            inner = mir.MirMap(rel, key_exprs + (v,))
+            proj = mir.MirProject(
+                inner, tuple(range(arity_in, arity_in + nk + 1))
+            )
+            branches.append(
+                mir.MirReduce(
+                    mir.MirDistinct(proj),
+                    group_key=tuple(range(nk)),
+                    aggregates=tuple(mir_aggs[i] for i in per_branch[bid]),
+                )
+            )
+            order.append(bid)
+        if len(branches) == 1:
+            return branches[0]
+        arities = [nk + len(per_branch[b]) for b in order]
+        offsets = [sum(arities[:i]) for i in range(len(arities))]
+        equivs = tuple(
+            tuple(offsets[j] + k for j in range(len(order)))
+            for k in range(nk)
+        )
+        join = mir.MirJoin(
+            inputs=tuple(branches), equivalences=equivs, null_safe=True
+        )
+        pos: dict[int, int] = {}
+        for j, b in enumerate(order):
+            for local, i in enumerate(per_branch[b]):
+                pos[i] = offsets[j] + nk + local
+        out = tuple(range(nk)) + tuple(pos[i] for i in range(len(mir_aggs)))
+        return mir.MirProject(join, out)
 
     def _rewrite_post(self, e):
         """Rewrite a post-aggregation AST: group exprs → _PostCol, aggs → _PostCol/avg."""

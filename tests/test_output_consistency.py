@@ -100,11 +100,39 @@ class QueryGen:
             # IN / NOT IN subquery (top-level conjunct)
             neg = "NOT " if self.rng.random() < 0.5 else ""
             return f"SELECT a, b FROM t1 WHERE a {neg}IN (SELECT x FROM t2)"
-        if kind < 0.93:
+        if kind < 0.9:
             # scalar subquery comparison
             agg = self.rng.choice(["min", "max", "count"])
             return f"SELECT a FROM t1 WHERE b > (SELECT {agg}(y) FROM t2)"
-        if kind < 0.97:
+        if kind < 0.93:
+            # DISTINCT aggregates
+            agg = self.rng.choice(["count", "sum", "avg"])
+            q = f"SELECT a, {agg}(DISTINCT b), count(*) FROM t1"
+            if self.rng.random() < 0.5:
+                q += f" WHERE {self.predicate(['a', 'b', 'c'])}"
+            return q + " GROUP BY a"
+        if kind < 0.96:
+            # window functions (explicit NULLS placement: sqlite defaults
+            # to NULLS FIRST ascending, pg to NULLS LAST)
+            nl = self.rng.choice(["NULLS FIRST", "NULLS LAST"])
+            f = self.rng.choice(
+                [
+                    "row_number()",
+                    "rank()",
+                    "dense_rank()",
+                    "sum(b)",
+                    "count(b)",
+                    "min(b)",
+                    "max(b)",
+                    "lag(b)",
+                    "lead(b)",
+                ]
+            )
+            # a total order inside the partition keeps row_number/lag/lead
+            # deterministic up to interchangeable identical rows
+            over = f"PARTITION BY a ORDER BY b {nl}, c {nl}"
+            return f"SELECT a, b, c, {f} OVER ({over}) FROM t1"
+        if kind < 0.98:
             # deterministic ORDER BY + LIMIT (full column order disambiguates)
             k = int(self.rng.integers(1, 8))
             nl = self.rng.choice(["NULLS FIRST", "NULLS LAST"])
@@ -113,6 +141,13 @@ class QueryGen:
             )
         # distinct
         return "SELECT DISTINCT b FROM t1"
+
+    def is_ordered(self, q: str) -> bool:
+        """Top-level ORDER BY only — an ORDER BY inside OVER (...) does not
+        constrain the output order."""
+        import re
+
+        return bool(re.search(r"ORDER BY(?![^(]*\))", q)) and "OVER" not in q
 
 
 @pytest.mark.parametrize("seed", [3, 11])
@@ -170,7 +205,7 @@ def test_output_consistency_vs_sqlite(seed):
     n_q = 30
     for qi in range(n_q):
         q = gen.query()
-        ordered = "ORDER BY" in q
+        ordered = gen.is_ordered(q)
         lite_rows = [norm(row) for row in lite.execute(q)]
         mzt_rows = [norm(row) for row in coord.execute(q).rows]
         if not ordered:
