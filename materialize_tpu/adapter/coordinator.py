@@ -861,7 +861,9 @@ class Coordinator:
         if state.upper <= state.since and not state.batches:
             return
         store = self.storage[gid]
-        for cols in m.snapshot(max(state.upper - 1, state.since)):
+        # upper-1 is the newest complete time; since ≤ upper-1 is a shard
+        # invariant (downgrade_since caps), so this read is always definite
+        for cols in m.snapshot(max(state.upper - 1, 0)):
             data = [cols[f"c{i}"] for i in range(len(store.dtypes))]
             batch = UpdateBatch.build((), tuple(data), cols["times"], cols["diffs"])
             store.arr.insert(batch)
@@ -969,6 +971,7 @@ class Coordinator:
         ts: int,
         persist: bool = True,
         extra_shards: dict | None = None,
+        on_durable=None,
     ) -> None:
         """Group commit: append to storage (and persist shards), then flow
         through every installed dataflow in dependency order (an MV's output
@@ -1008,8 +1011,19 @@ class Coordinator:
                 atomic=len(writes) + len(extra_shards or {}) > 1,
                 extra_shards=extra_shards,
             )
+            # The durable commit point has passed: let the caller advance
+            # source offsets/upsert state NOW. A failure below (dataflow
+            # step, MV persist) must NOT roll sources back to re-ingest
+            # records the base shards already durably hold (advisor r2).
+            if on_durable is not None:
+                on_durable()
         for gid, batch in writes.items():
             self.storage[gid].append(batch, ts)
+        # Without durability the in-memory base-table append IS the commit
+        # point; firing earlier would drop polled records forever if the
+        # append itself failed (nothing durable exists to recover them from).
+        if on_durable is not None and not (persist and self.durable):
+            on_durable()
         for mv_gid, df, src_gids in self.dataflows:
             deltas = {g: env[g] for g in src_gids if g in env}
             if not deltas and not df.has_temporal:
@@ -1102,7 +1116,9 @@ class Coordinator:
                     pass  # best-effort; the next maintenance pass retries
             if ts % 64 == 0:
                 try:
-                    self._txn_machine().gc()
+                    tm = self._txn_machine()
+                    tm.forget_applied()  # retire applied commits first,
+                    tm.gc()  # then sweep the now-unreferenced payloads
                 except (IOError, RuntimeError):
                     pass
 
@@ -1123,19 +1139,31 @@ class Coordinator:
                 if t in gids:
                     writes[gids[t]] = b
         remap, committed = self._poll_file_sources(writes, ts, n_rows)
-        if writes:
+        # remap alone (all polled lines blank/malformed) still commits: the
+        # binding must advance src.offset or the same bytes are re-read and
+        # re-counted in decode_errors every tick (advisor r2, low)
+        if writes or remap:
+            durable_point_passed = False
+
+            def _advance_sources():
+                nonlocal durable_point_passed
+                durable_point_passed = True
+                for src, new_offset, _backup in committed:
+                    src.offset = new_offset
+
             try:
-                self._apply_writes(writes, ts, extra_shards=remap)
+                self._apply_writes(
+                    writes, ts, extra_shards=remap, on_durable=_advance_sources
+                )
             except Exception:
-                # nothing was committed: roll the pollers back so the
-                # records are re-polled next tick (offsets/upsert state must
-                # never run ahead of the durable remap binding)
-                for src, _new_offset, backup in committed:
-                    if backup is not None:
-                        backup[0].state = backup[1]
+                if not durable_point_passed:
+                    # nothing was committed: roll the pollers back so the
+                    # records are re-polled next tick (offsets/upsert state
+                    # must never run ahead of the durable remap binding)
+                    for src, _new_offset, backup in committed:
+                        if backup is not None:
+                            backup[0].state = backup[1]
                 raise
-            for src, new_offset, _backup in committed:
-                src.offset = new_offset
         return ts
 
     # -- external file sources -------------------------------------------------

@@ -701,7 +701,9 @@ class FusedDataflow:
         for data, _t, d in self.index_errs[index_id].rows_host(at):
             acc[data] = acc.get(data, 0) + d
         if any(v > 0 for v in acc.values()):
-            raise RuntimeError(f"peek {index_id}: error collection non-empty: {acc}")
+            from .runtime import peek_error_message
+
+            raise RuntimeError(peek_error_message(index_id, acc))
         out: dict[tuple, int] = {}
         for data, _t, d in self.index_traces[index_id].rows_host(at):
             out[data] = out.get(data, 0) + d

@@ -684,6 +684,22 @@ def materialize_counts(acc: dict, label: str) -> list[tuple]:
     return rows
 
 
+def peek_error_message(index_id: str, acc: dict) -> str:
+    """Human-readable message for a non-empty error collection: decodes
+    EvalErr codes from error rows (which carry (code, ...) tuples) — shared
+    by the host-path and fused-path peeks so both render identically."""
+    from ..expr.scalar import EvalErr
+
+    def _msg(data):
+        try:
+            return EvalErr(int(data[0])).name.lower().replace("_", " ")
+        except (ValueError, TypeError, IndexError):
+            return str(data)
+
+    msgs = sorted({_msg(d) for d, v in acc.items() if v > 0})
+    return f"peek {index_id}: error: {'; '.join(msgs)}"
+
+
 def _retime(batch: UpdateBatch, tick: int) -> UpdateBatch:
     """Overwrite live rows' times with the outer tick (iteration timestamps
     are scope-private, like the inner coordinate of a product timestamp)."""
@@ -957,7 +973,7 @@ class Dataflow:
         for data, _t, d in self.index_errs[index_id].rows_host(at):
             acc[data] = acc.get(data, 0) + d
         if any(v > 0 for v in acc.values()):
-            raise RuntimeError(f"peek {index_id}: error collection non-empty: {acc}")
+            raise RuntimeError(peek_error_message(index_id, acc))
         out: dict[tuple, int] = {}
         for data, _t, d in self.index_traces[index_id].rows_host(at):
             out[data] = out.get(data, 0) + d

@@ -306,6 +306,11 @@ class ShardMachine:
             now = _time.time()
             hold = state.min_unexpired_hold(now)
             capped = since if hold is None else min(since, hold)
+            # since must stay strictly below upper: a quiet shard fed a global
+            # compaction frontier would otherwise end with since > upper and
+            # no definite read time left — snapshot(upper-1) then fails at
+            # boot rehydration (found by round-3 verify)
+            capped = min(capped, max(state.upper - 1, 0))
             # expired leases are swept here (the maintenance path), so an
             # abandoned reader only blocks compaction for its lease duration
             readers = {

@@ -124,7 +124,8 @@ class TxnsMachine:
         payloads are reclaimed. Returns applied count.
         """
         applied = 0
-        for t, records in self._records_below(upper, min_t=self._applied_through):
+        pairs, observed_upper = self._records_below(upper, min_t=self._applied_through)
+        for t, records in pairs:
             for shard_id, key, _n in records:
                 m = self.data_shard(shard_id)
                 cur = m.upper()
@@ -156,8 +157,13 @@ class TxnsMachine:
                         self.blob.delete(key)
                     except Exception:
                         pass  # gc() sweeps stragglers
+        # Cap at the upper observed in the SAME fetch_state that enumerated
+        # the records: a commit landing between that fetch and now would have
+        # ts below a fresh upper and be skipped by the min_t fast path forever
+        # (advisor r2, low — benign under single-writer fencing, but the class
+        # claims concurrent-applier support).
         self._applied_through = max(
-            self._applied_through, min(upper, self.txns.upper())
+            self._applied_through, min(upper, observed_upper)
         )
         return applied
 
@@ -175,9 +181,10 @@ class TxnsMachine:
         return self.data_shard(shard_id).snapshot(as_of)
 
     def _records_below(self, upper: int, min_t: int = 0):
-        """(time, records) pairs of txn commits with min_t <= time < upper,
-        ascending. A commit batch's time is its manifest upper - 1 (commit
-        always appends [lower, ts+1)), so skipped batches cost no blob I/O."""
+        """((time, records) pairs of txn commits with min_t <= time < upper,
+        ascending; txns upper observed in the same state fetch). A commit
+        batch's time is its manifest upper - 1 (commit always appends
+        [lower, ts+1)), so skipped batches cost no blob I/O."""
         _seq, state = self.txns.fetch_state()
         out = []
         for b in state.batches:
@@ -192,7 +199,59 @@ class TxnsMachine:
                 continue
             out.append((t, json.loads(_unpack_lanes(cols["recjson"]).decode())))
         out.sort(key=lambda p: p[0])
-        return out
+        return out, state.upper
+
+    def forget_applied(self) -> int:
+        """Retire txns-shard batches whose commits are durably applied.
+
+        Without retirement every multi-shard commit appends one manifest entry
+        forever: consensus state, fetch_state parse cost and _records_below
+        scans all grow without bound (advisor r2; reference analogue:
+        txn-wal's compact_to/forget, src/txn-wal/src/lib.rs). A record is
+        retired once every data shard's upper has passed its time — recovery
+        can never need it again. Uppers are read BEFORE the manifest CAS is
+        conditioned on the fetched seqno, so a racing commit aborts the CAS
+        and the next maintenance pass retries. Returns retired batch count.
+        """
+        seqno, state = self.txns.fetch_state()
+        keep, retired, upper_cache = [], [], {}
+        for b in state.batches:
+            if not b.count:
+                continue  # pure upper advancement: no payload to retire
+            payload = self.blob.get(b.key)
+            if payload is None:
+                raise IOError(f"txn-wal: txns batch {b.key} missing")
+            cols = decode_columns(payload)
+            t = int(cols["times"][0])
+            records = json.loads(_unpack_lanes(cols["recjson"]).decode())
+            done = True
+            for shard_id, _key, _n in records:
+                u = upper_cache.get(shard_id)
+                if u is None:
+                    u = upper_cache[shard_id] = self.data_shard(shard_id).upper()
+                if u <= t:
+                    done = False
+                    break
+            (retired if done else keep).append(b)
+        if not retired:
+            return 0
+        from .shard import ShardState
+
+        hollow = [b for b in state.batches if not b.count]
+        new_state = ShardState(
+            since=state.since, upper=state.upper, batches=hollow + keep,
+            epoch=state.epoch, readers=state.readers,
+        )
+        if not self.txns.consensus.compare_and_set(
+            self.txns._key, seqno, new_state.encode()
+        ):
+            return 0  # racing commit; retry next maintenance pass
+        for b in retired:
+            try:
+                self.blob.delete(b.key)
+            except Exception:
+                pass  # shard gc sweeps stragglers
+        return len(retired)
 
     def gc(self, grace_secs: float = 300.0) -> int:
         """Sweep txnbatch payloads that no txns record references (crash
@@ -203,7 +262,7 @@ class TxnsMachine:
         import time as _time
 
         referenced = set()
-        for _t, records in self._records_below(1 << 62):
+        for _t, records in self._records_below(1 << 62)[0]:
             for _shard_id, key, _n in records:
                 if key is not None:
                     referenced.add(key)

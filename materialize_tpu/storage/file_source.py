@@ -63,12 +63,16 @@ class FileTailSource:
             chunk = f.read(size - self.offset)
         records = []
         consumed = 0
-        for line in chunk.splitlines(keepends=True):
-            if not line.endswith(b"\n"):
-                break  # incomplete tail
+        # Split strictly on b'\n': splitlines() also breaks on \r, \v, \f,
+        # \x1c-\x1e and \x85, and a lone such byte (legal inside a quoted CSV
+        # field) would yield a segment that never ends with \n — wedging
+        # ingestion at that offset forever (advisor r2, medium). With
+        # split(b"\n") only the genuinely unterminated final piece is deferred.
+        pieces = chunk.split(b"\n")
+        for line in pieces[:-1]:  # pieces[-1] is the partial (or empty) tail
             if len(records) >= max_records:
                 break
-            consumed += len(line)
+            consumed += len(line) + 1  # + the delimiter
             text = line.decode(errors="replace").strip()
             if not text:
                 continue

@@ -1,0 +1,275 @@
+"""Regression tests for round-2 advisor findings (ADVICE.md r2).
+
+1. medium coordinator.py — a failure AFTER the durable txn-wal commit must not
+   roll sources back (double-ingest); offsets advance at the commit point.
+2. medium file_source.py — a stray \\r (or other splitlines() break byte)
+   inside a line must not wedge ingestion at that offset forever.
+3. low coordinator.py — a poll that decodes to an empty batch still commits
+   the remap binding / advances the offset (no re-read + re-count loop).
+4. low persist/txn.py — fully-applied txns-shard records are retired so the
+   txns log does not grow without bound.
+5. low persist/txn.py — _applied_through is capped at the txns upper observed
+   in the same fetch that enumerated records (no skipped concurrent commit).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.persist import MemBlob, MemConsensus
+from materialize_tpu.persist.txn import TxnsMachine
+from materialize_tpu.storage.file_source import FileSourceSpec, FileTailSource
+
+
+def cols(data, times, diffs):
+    return {
+        "c0": np.asarray(data, dtype=np.int64),
+        "times": np.asarray(times, dtype=np.uint64),
+        "diffs": np.asarray(diffs, dtype=np.int64),
+    }
+
+
+# -- 2: stray carriage return inside a CSV quoted field ----------------------
+
+
+def test_stray_cr_does_not_wedge_ingestion(tmp_path):
+    p = tmp_path / "feed.csv"
+    # a lone \r inside a quoted field: splitlines() used to yield a segment
+    # not ending in \n, firing the incomplete-tail break forever
+    p.write_bytes(b'1,"a\rb",10\n2,y,20\n')
+    src = FileTailSource(
+        FileSourceSpec(str(p), "csv", ("id", "tag", "amt"))
+    )
+    records, new_offset = src.poll()
+    assert new_offset == p.stat().st_size
+    assert [r["id"] for r in records] == ["1", "2"]
+    assert records[0]["tag"] == "a\rb"
+    # fully caught up: nothing re-read
+    src.offset = new_offset
+    records2, off2 = src.poll()
+    assert records2 == [] and off2 == new_offset
+
+
+def test_partial_tail_still_deferred(tmp_path):
+    p = tmp_path / "feed.csv"
+    p.write_bytes(b"1,x,10\n2,y")  # unterminated final line
+    src = FileTailSource(FileSourceSpec(str(p), "csv", ("id", "tag", "amt")))
+    records, new_offset = src.poll()
+    assert [r["id"] for r in records] == ["1"]
+    assert new_offset == len(b"1,x,10\n")
+    with open(p, "ab") as f:
+        f.write(b",20\n")
+    src.offset = new_offset
+    records, new_offset = src.poll()
+    assert [r["id"] for r in records] == ["2"]
+    assert new_offset == p.stat().st_size
+
+
+# -- 3: malformed-only polls advance the offset ------------------------------
+
+
+def test_malformed_only_poll_advances_offset(tmp_path):
+    p = tmp_path / "feed.jsonl"
+    p.write_text("NOT JSON AT ALL\n")
+    c = Coordinator()
+    c.execute(f"CREATE SOURCE feed (id int) FROM FILE '{p}' (FORMAT JSON)")
+    c.advance()
+    src, _gid, _u = c.file_sources[0]
+    assert src.decode_errors == 1
+    assert src.offset == p.stat().st_size  # offset moved despite empty batch
+    c.advance()
+    assert src.decode_errors == 1  # not re-counted
+    with open(p, "a") as f:
+        f.write(json.dumps({"id": 7}) + "\n")
+    c.advance()
+    assert c.execute("SELECT id FROM feed").rows == [(7,)]
+    assert src.decode_errors == 1
+
+
+# -- 1: post-commit failure must not double-ingest ---------------------------
+
+
+def test_post_commit_failure_does_not_double_ingest(tmp_path):
+    p = tmp_path / "feed.jsonl"
+    d = str(tmp_path / "data")
+    p.write_text(json.dumps({"id": 1}) + "\n")
+    c = Coordinator(data_dir=d)
+    c.execute(f"CREATE SOURCE feed (id int) FROM FILE '{p}' (FORMAT JSON)")
+    src, gid, _u = c.file_sources[0]
+
+    # fail AFTER the durable commit: in-memory propagation raises
+    store = c.storage[gid]
+    real_append = store.append
+    armed = {"on": True}
+
+    def bomb(batch, tick):
+        if armed["on"]:
+            armed["on"] = False
+            raise RuntimeError("injected post-commit failure")
+        return real_append(batch, tick)
+
+    store.append = bomb
+    with pytest.raises(RuntimeError, match="injected"):
+        c.advance()
+    # the durable commit happened, so the offset must have advanced: the next
+    # tick must NOT re-poll and re-commit the same record at a new ts
+    assert src.offset == p.stat().st_size
+    c.advance()
+
+    # restart from durable state: the row exists exactly once
+    del c
+    c2 = Coordinator(data_dir=d)
+    assert c2.execute("SELECT id FROM feed").rows == [(1,)]
+
+
+def test_pre_commit_failure_still_rolls_back(tmp_path):
+    """A failure BEFORE the durable commit keeps the old rollback contract."""
+    p = tmp_path / "feed.jsonl"
+    d = str(tmp_path / "data")
+    p.write_text(json.dumps({"id": 1}) + "\n")
+    c = Coordinator(data_dir=d)
+    c.execute(f"CREATE SOURCE feed (id int) FROM FILE '{p}' (FORMAT JSON)")
+    src, _gid, _u = c.file_sources[0]
+
+    real_persist = c._persist_batches
+    armed = {"on": True}
+
+    def bomb(*a, **kw):
+        if armed["on"]:
+            armed["on"] = False
+            raise RuntimeError("injected pre-commit failure")
+        return real_persist(*a, **kw)
+
+    c._persist_batches = bomb
+    with pytest.raises(RuntimeError, match="injected"):
+        c.advance()
+    assert src.offset == 0  # rolled back: nothing was durable
+    c.advance()  # re-polls the same bytes; ingests exactly once
+    assert c.execute("SELECT id FROM feed").rows == [(1,)]
+
+
+# -- 4: txns-shard retirement ------------------------------------------------
+
+
+def test_txns_shard_retires_applied_records():
+    blob, cas = MemBlob(), MemConsensus()
+    tx = TxnsMachine(blob, cas)
+    for i in range(5):
+        tx.commit({"a": cols([i], [i], [1]), "b": cols([i * 10], [i], [1])}, i)
+    _s, state = tx.txns.fetch_state()
+    live = [b for b in state.batches if b.count]
+    assert len(live) == 5
+    retired_keys = [b.key for b in live]
+
+    assert tx.forget_applied() == 5
+    _s, state2 = tx.txns.fetch_state()
+    assert [b for b in state2.batches if b.count] == []
+    assert state2.upper == state.upper  # read frontier untouched
+    for k in retired_keys:
+        assert blob.get(k) is None  # manifest payloads reclaimed
+
+    # a fresh machine (restart) still reads complete data
+    tx2 = TxnsMachine(blob, cas)
+    snap = tx2.snapshot("a", 4)
+    vals = sorted(int(v) for c in snap for v in c["c0"])
+    assert vals == [0, 1, 2, 3, 4]
+
+
+def test_txns_shard_keeps_unapplied_records():
+    blob, cas = MemBlob(), MemConsensus()
+    tx = TxnsMachine(blob, cas)
+    tx.commit({"a": cols([1], [0], [1])}, 0)
+
+    # a commit whose apply is suppressed (crash-after-commit analogue)
+    orig = TxnsMachine.apply_up_to
+    TxnsMachine.apply_up_to = lambda self, upper: 0
+    try:
+        tx.commit({"a": cols([2], [1], [1])}, 1)
+    finally:
+        TxnsMachine.apply_up_to = orig
+
+    assert tx.forget_applied() == 1  # only the applied record retires
+    _s, state = tx.txns.fetch_state()
+    assert len([b for b in state.batches if b.count]) == 1
+    # recovery replays the kept record, then it too can retire
+    tx.apply_up_to(2)
+    assert tx.forget_applied() == 1
+    snap = TxnsMachine(blob, cas).snapshot("a", 1)
+    vals = sorted(int(v) for c in snap for v in c["c0"])
+    assert vals == [1, 2]
+
+
+# -- 5: _applied_through vs concurrent commit --------------------------------
+
+
+def test_applied_through_capped_at_observed_upper():
+    blob, cas = MemBlob(), MemConsensus()
+    tx = TxnsMachine(blob, cas)
+    tx.commit({"a": cols([1], [0], [1])}, 0)
+    other = TxnsMachine(blob, cas)
+
+    # inject a concurrent commit between tx's state fetch and its
+    # _applied_through update; suppress other's own apply so the record
+    # stays unapplied (its applier "crashed" right after the commit point)
+    real_fetch = tx.txns.fetch_state
+    fired = {"done": False}
+
+    def racing_fetch():
+        r = real_fetch()
+        if not fired["done"]:
+            fired["done"] = True
+            orig = TxnsMachine.apply_up_to
+            TxnsMachine.apply_up_to = lambda self, upper: 0
+            try:
+                other.commit({"a": cols([2], [1], [1])}, 1)
+            finally:
+                TxnsMachine.apply_up_to = orig
+        return r
+
+    tx.txns.fetch_state = racing_fetch
+    tx.apply_up_to(10)  # observes pre-race state; must not claim ts 1 applied
+    tx.txns.fetch_state = real_fetch
+    assert tx._applied_through <= 1
+
+    tx.apply_up_to(10)  # now sees and applies the raced commit
+    assert tx.data_shard("a").upper() == 2
+    snap = tx.snapshot("a", 1)
+    vals = sorted(int(v) for c in snap for v in c["c0"])
+    assert vals == [1, 2]
+
+
+# -- found by round-3 verify: since must never pass upper --------------------
+
+
+def test_downgrade_since_capped_below_upper():
+    from materialize_tpu.persist import ShardMachine
+
+    blob, cas = MemBlob(), MemConsensus()
+    m = ShardMachine(blob, cas, "quiet")
+    m.compare_and_append(cols([1], [1], [1]), 0, 2)
+    # a global compaction frontier way past this quiet shard's upper
+    m.downgrade_since(32)
+    assert m.since() == 1  # capped at upper - 1: a definite read remains
+    snap = m.snapshot(1)
+    assert [int(v) for c in snap for v in c["c0"]] == [1]
+
+
+def test_idle_source_survives_compaction_and_restart(tmp_path):
+    """An idle shard must stay readable at boot after many compaction passes
+    advance the global since frontier far beyond its upper."""
+    p = tmp_path / "feed.csv"
+    d = str(tmp_path / "data")
+    p.write_text("1,x,10\n")
+    c = Coordinator(data_dir=d)
+    c.execute(f"CREATE SOURCE feed (id int, tag text, amt int) FROM FILE '{p}' (FORMAT CSV)")
+    c.execute("CREATE TABLE busy (n int)")
+    c.advance()
+    for i in range(40):  # crosses several ts%16 maintenance strides
+        c.execute(f"INSERT INTO busy VALUES ({i})")
+        c.advance()
+    del c
+    c2 = Coordinator(data_dir=d)  # must not raise at rehydration
+    assert c2.execute("SELECT id FROM feed").rows == [(1,)]
+    assert c2.execute("SELECT count(*) FROM busy").rows == [(40,)]

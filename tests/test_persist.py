@@ -60,11 +60,16 @@ def test_empty_advance():
 def test_snapshot_bounds():
     m = mkshard()
     m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    m.compare_and_append(cols([2], [1], [1]), 1, 2)
     m.downgrade_since(1)
     with pytest.raises(ValueError):
         m.snapshot(0)  # below since
     with pytest.raises(ValueError):
         m.snapshot(5)  # not yet complete
+    # since never passes upper-1: a definite read time always remains
+    m.downgrade_since(99)
+    assert m.since() == 1
+    m.snapshot(1)
 
 
 def test_file_backed_durability(tmp_path):
@@ -139,6 +144,7 @@ def test_leased_reader_holds_since():
 def test_expired_lease_unblocks_compaction():
     m = mkshard()
     m.compare_and_append(cols([1], [0], [1]), 0, 1)
+    m.compare_and_append(cols([2], [1], [1]), 1, 2)
     m.register_reader("dead", lease_secs=0.0)  # instantly expired
     import time
 

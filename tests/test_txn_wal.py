@@ -111,7 +111,7 @@ def test_partial_apply_crash_is_idempotent():
 
     def dying_apply(self, upper):
         # apply shard 'a' then crash
-        recs = self._records_below(upper)
+        recs, _upper = self._records_below(upper)
         for t, records in recs:
             for shard_id, key, _n in sorted(records):
                 m = self.data_shard(shard_id)
