@@ -8,8 +8,10 @@ numbers are published; the methodology is relative).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
-Env knobs: MZT_BENCH_SF (default 0.1), MZT_BENCH_TICKS (default 5),
-MZT_BENCH_FRAC (default 0.005 — fraction of orders churned per tick).
+Env knobs: MZT_BENCH_SF (default 1), MZT_BENCH_TICKS (default 5),
+MZT_BENCH_FRAC (default 0.02 — fraction of orders churned per tick).
+A wedged TPU pool fails LOUDLY after retries (exit 2, no metric line);
+MZT_BENCH_ALLOW_CPU=1 opts into a clearly-suffixed CPU dev run.
 """
 
 import contextlib
@@ -42,7 +44,7 @@ def build_tpu_side(sf, ticks, frac, seed, scale=1):
     from materialize_tpu.repr.batch import bucket_cap
     from materialize_tpu.storage import TpchGenerator
 
-    gen = TpchGenerator(sf=sf, seed=seed)
+    gen = TpchGenerator(sf=sf, seed=seed, val_dtype=np.int32)
     init = gen.initial_batches(1)
     n_orders = gen.n_orders
     n_li = len(gen._lineitem_store[0]) if gen._lineitem_store else int(4 * n_orders)
@@ -55,6 +57,7 @@ def build_tpu_side(sf, ticks, frac, seed, scale=1):
         bucket=1 << 10,
         join_out=bucket_cap(per_tick * 2),
         groups=bucket_cap(max(int(n_orders * 0.35), 64) * scale),
+        val_dtype="int32",
     )
     # steady-state ticks never touch customer (TPC-H RF1/RF2): compile the
     # variant with the customer path statically removed
@@ -99,7 +102,7 @@ def run_tpu(sf, ticks, frac, seed=0, scale=1, max_rescale=3):
         # pre-generate refresh ticks (host generation excluded from timing)
         from materialize_tpu.repr import UpdateBatch
 
-        empty_c = UpdateBatch.empty(8, (), (np.dtype(np.int64),) * 3)
+        empty_c = UpdateBatch.empty(8, (), (np.dtype(np.int32),) * 3)
         refreshes = []
         tick_counts = []  # per-tick update counts, computed pre-transfer
         for t in range(2, 2 + ticks + 1):  # +1 warmup
@@ -261,22 +264,55 @@ def _device_preflight() -> bool:
         return False
 
 
+def _require_device() -> None:
+    """Wait for the chip with retries; die LOUDLY if it never appears.
+
+    A wedged pool must produce a visible failure (nonzero exit, no metric
+    line), never a silently recorded CPU number: two rounds of `_cpu_fallback`
+    metrics taught us a bench that records a meaningless value is itself a
+    defect. Explicit CPU runs remain available via MZT_BENCH_ALLOW_CPU=1
+    (clearly suffixed `_cpu_fallback`, for local development only).
+    """
+    if os.environ.get("MZT_BENCH_NO_PREFLIGHT") == "1":
+        return
+    if os.environ.get("MZT_BENCH_ALLOW_CPU") == "1":
+        if not _device_preflight():
+            print("# preflight failed; MZT_BENCH_ALLOW_CPU=1 → CPU run", file=sys.stderr)
+            env = dict(os.environ)
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env.pop("JAX_PLATFORMS", None)
+            env["MZT_BENCH_NO_PREFLIGHT"] = "1"
+            env["MZT_BENCH_CPU_FALLBACK"] = "1"
+            os.execve(sys.executable, [sys.executable, __file__], env)
+        return
+    attempts = int(os.environ.get("MZT_PREFLIGHT_RETRIES", "3"))
+    wait = int(os.environ.get("MZT_PREFLIGHT_WAIT", "300"))
+    for i in range(attempts):
+        if _device_preflight():
+            return
+        _phase(
+            f"device preflight attempt {i + 1}/{attempts} failed"
+            + (f"; waiting {wait}s for the pool to unwedge" if i + 1 < attempts else "")
+        )
+        if i + 1 < attempts:
+            time.sleep(wait)
+    print(
+        "FATAL: TPU device preflight failed after "
+        f"{attempts} attempts — the pool is wedged or unreachable. "
+        "Refusing to record a CPU number as the benchmark result. "
+        "(Set MZT_BENCH_ALLOW_CPU=1 for an explicitly-labeled CPU dev run.)",
+        file=sys.stderr,
+        flush=True,
+    )
+    sys.exit(2)
+
+
 def main():
-    sf = float(os.environ.get("MZT_BENCH_SF", "0.1"))
+    sf = float(os.environ.get("MZT_BENCH_SF", "1"))
     ticks = int(os.environ.get("MZT_BENCH_TICKS", "5"))
-    frac = float(os.environ.get("MZT_BENCH_FRAC", "0.005"))
+    frac = float(os.environ.get("MZT_BENCH_FRAC", "0.02"))
 
-    if os.environ.get("MZT_BENCH_NO_PREFLIGHT") != "1" and not _device_preflight():
-        # TPU tunnel wedged: re-exec on pure CPU so the driver still gets a
-        # (clearly labeled) number instead of a hang
-        print("# device preflight failed; falling back to CPU", file=sys.stderr)
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)
-        env.pop("JAX_PLATFORMS", None)
-        env["MZT_BENCH_NO_PREFLIGHT"] = "1"
-        env["MZT_BENCH_CPU_FALLBACK"] = "1"
-        os.execve(sys.executable, [sys.executable, __file__], env)
-
+    _require_device()
     _phase("preflight ok")
     tpu_rate, n_tpu, t_tpu = run_tpu(sf, ticks, frac)
     print(

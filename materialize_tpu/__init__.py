@@ -21,11 +21,12 @@ operator, so kernels compose without masks).
 
 import jax
 
-# The engine's core dtypes are u64 hashes/timestamps and i64 diffs, matching
-# the reference's `mz_repr::Timestamp` (u64 ms) and `Diff` (i64)
+# The engine's core dtypes are u64 timestamps and i64 diffs, matching the
+# reference's `mz_repr::Timestamp` (u64 ms) and `Diff` (i64)
 # (reference: src/repr/src/timestamp.rs:46, src/repr/src/diff.rs:11).
-# On TPU, 64-bit integer ops are emulated on the 32-bit VPU; the hot kernels
-# keep 64-bit data off the critical path where possible.
+# Row hashes are u32 (repr/hashing.py): 64-bit integer ops are emulated on
+# the 32-bit TPU VPU, so the sort/search/route hot path stays 32-bit and
+# collisions are handled by key-equality verification.
 jax.config.update("jax_enable_x64", True)
 
 # Kernel shapes recur across ticks, restarts, and processes (pow2-bucketed

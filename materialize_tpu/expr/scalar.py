@@ -104,7 +104,8 @@ class EvalErr(enum.IntEnum):
     # reduce lookup scanned _MAX_HASH_COLLISIONS slots of one hash bucket
     # without resolving the probe: the answer would be unsound, so the tick
     # reports an error instead of silently dropping the group (needs >4
-    # distinct live keys sharing one 64-bit hash)
+    # distinct live keys sharing one 32-bit hash — rare but plausible at
+    # tens of millions of keys; detected, never silent)
     HASH_COLLISION_EXHAUSTED = 3
 
 

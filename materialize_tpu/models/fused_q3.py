@@ -67,6 +67,10 @@ class Q3Caps:
     join_out: int = 1 << 12
     groups: int = 1 << 15
     levels: int = 3
+    # value-column dtype: "int32" halves gather/sort/HBM cost on the 32-bit
+    # TPU VPU; every TPC-H column fits i32 through SF100 (generator.py).
+    # Aggregate accumulators stay i64 regardless.
+    val_dtype: str = "int64"
 
     def arr_levels(self, full: int) -> tuple:
         return level_caps(full, self.delta * 4, self.levels)
@@ -93,13 +97,17 @@ class Q3State:
 
     @staticmethod
     def empty(caps: Q3Caps) -> "Q3State":
+        V = np.dtype(caps.val_dtype)
+        # the revenue closure multiplies an i32 column by an i64 literal,
+        # promoting the aggregate-input (4th grouped val) to i64 — but group
+        # KEYS (lk, od, sp) keep the value dtype
         return Q3State(
-            cust_by_ck=LsmBatches.empty(caps.arr_levels(caps.cust), (I64,), (I64,)),
-            ord_by_ck=LsmBatches.empty(caps.arr_levels(caps.orders), (I64,), (I64,) * 4),
-            ord_by_ok=LsmBatches.empty(caps.arr_levels(caps.orders), (I64,), (I64,) * 4),
-            li_by_ok=LsmBatches.empty(caps.arr_levels(caps.lineitem), (I64,), (I64,) * 3),
+            cust_by_ck=LsmBatches.empty(caps.arr_levels(caps.cust), (V,), (V,)),
+            ord_by_ck=LsmBatches.empty(caps.arr_levels(caps.orders), (V,), (V,) * 4),
+            ord_by_ok=LsmBatches.empty(caps.arr_levels(caps.orders), (V,), (V,) * 4),
+            li_by_ok=LsmBatches.empty(caps.arr_levels(caps.lineitem), (V,), (V,) * 3),
             accum=LsmAccums.empty(
-                caps.arr_levels(caps.groups), (I64, I64, I64), (I64,)
+                caps.arr_levels(caps.groups), (V, V, V), (I64,)
             ),
         )
 
@@ -183,9 +191,11 @@ def q3_tick(
     track(f)
     dl, f = _maybe_exchange(dl, axis_name, n_shards, caps.bucket)
     track(f)
-    do_ck = consolidate(do_ck)
-    do_ok = consolidate(do_ok)
-    dl = consolidate(dl)
+    # probe streams: skip the compaction sort — dead rows stay inert and
+    # these batches are never capacity-shrunk (ops/consolidate.py)
+    do_ck = consolidate(do_ck, compact=False)
+    do_ok = consolidate(do_ok, compact=False)
+    dl = consolidate(dl, compact=False)
 
     outs = []
     if with_cust:
@@ -193,14 +203,14 @@ def q3_tick(
         dc = arrange_batch(fc, (0,))
         dc, f = _maybe_exchange(dc, axis_name, n_shards, caps.bucket)
         track(f)
-        dc = consolidate(dc)
+        dc = consolidate(dc, compact=False)
         # path 0: d customer ⋈ orders(ck) ⋈ lineitem(ok)
         s0s, f = lsm_join(dc, state.ord_by_ck, jcaps)
         track(f)
         s0 = arrange_batch(_concat_all(s0s), (1,))  # key ok
         s0, f = _maybe_exchange(s0, axis_name, n_shards, caps.bucket)
         track(f)
-        s0s, f = lsm_join(consolidate(s0), state.li_by_ok, jcaps)
+        s0s, f = lsm_join(consolidate(s0, compact=False), state.li_by_ok, jcaps)
         track(f)
         outs += s0s  # (ck | ok,ck,od,sp | lk,ep,dc) = canonical
         new_cust, f = lsm_insert(state.cust_by_ck, dc, time, RATIO)
@@ -214,7 +224,7 @@ def q3_tick(
     s1 = arrange_batch(_concat_all(s1s), (0,))  # stream (ok,ck,od,sp | ck): key ok
     s1, f = _maybe_exchange(s1, axis_name, n_shards, caps.bucket)
     track(f)
-    s1s, f = lsm_join(consolidate(s1), state.li_by_ok, jcaps)
+    s1s, f = lsm_join(consolidate(s1, compact=False), state.li_by_ok, jcaps)
     track(f)
     outs += [_project_cols(s, (4, 0, 1, 2, 3, 5, 6, 7)) for s in s1s]
     new_ord_ck, f = lsm_insert(state.ord_by_ck, do_ck, time, RATIO)
@@ -228,18 +238,18 @@ def q3_tick(
     s2 = arrange_batch(_concat_all(s2s), (4,))  # stream (lk,ep,dc | ok,ck,od,sp): key ck
     s2, f = _maybe_exchange(s2, axis_name, n_shards, caps.bucket)
     track(f)
-    s2s, f = lsm_join(consolidate(s2), new_cust, jcaps)
+    s2s, f = lsm_join(consolidate(s2, compact=False), new_cust, jcaps)
     track(f)
     outs += [_project_cols(s, (7, 3, 4, 5, 6, 0, 1, 2)) for s in s2s]
     new_li, f = lsm_insert(state.li_by_ok, dl, time, RATIO)
     track(f)
 
     # closure + reduce
-    joined, errs1 = _CLOSURE.apply(consolidate(_concat_all(outs)))
+    joined, errs1 = _CLOSURE.apply(consolidate(_concat_all(outs), compact=False))
     grouped = arrange_batch(joined, (0, 1, 2))
     grouped, f = _maybe_exchange(grouped, axis_name, n_shards, caps.bucket)
     track(f)
-    grouped = consolidate(grouped)
+    grouped = consolidate(grouped, compact=False)
 
     raw_contrib, errs2 = _contributions(grouped, (0, 1, 2), _AGGS)
     contrib = consolidate_accums(raw_contrib)
@@ -247,12 +257,12 @@ def q3_tick(
     from ..ops.reduce import collision_errs
 
     errs3 = collision_errs(contrib, missed, time)
-    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
+    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time), compact=False)
     new_accum, f = accum_lsm_insert(state.accum, contrib, time, RATIO)
     track(f)
 
     errs = consolidate(
-        UpdateBatch.concat(UpdateBatch.concat(errs1, errs2), errs3)
+        UpdateBatch.concat(UpdateBatch.concat(errs1, errs2), errs3), compact=False
     )
     new_state = Q3State(new_cust, new_ord_ck, new_ord_ok, new_li, new_accum)
     # overflow as shape-(1,) so shard_map can concatenate per-device flags
@@ -348,6 +358,7 @@ def q3_state_global(caps: Q3Caps, n_shards: int) -> Q3State:
         join_out=caps.join_out * n_shards,
         groups=caps.groups * n_shards,
         levels=caps.levels,
+        val_dtype=caps.val_dtype,
     )
     return Q3State.empty(scaled)
 

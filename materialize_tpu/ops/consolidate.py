@@ -10,6 +10,8 @@ host round-trip.
 
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -31,23 +33,35 @@ def row_equal_prev(cols) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((1,), dtype=jnp.bool_), eq])
 
 
-@jax.jit
-def consolidate(batch: UpdateBatch) -> UpdateBatch:
+@partial(jax.jit, static_argnames=("compact",))
+def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
     """Canonicalize a batch: hash-sorted, equal rows merged, no zero diffs.
 
-    The sort key is (key_hash, row_hash, time) — 3 fixed operands instead of
-    the full row (TPU sorts cost per operand in both runtime and compile
-    time; this is the single hottest kernel). row_hash is a u64 content hash
-    of the val columns, so duplicate rows inside one key group still land
-    adjacent and annihilate; equal-row runs are then confirmed by full-row
-    adjacent comparison, which keeps correctness under hash collisions —
-    colliding distinct rows merely stay split across entries, and every
-    consumer treats a batch as a multiset of (row, time, diff) updates
-    (operators are linear in diff), so only perfect annihilation (a capacity
-    concern, not correctness) needs adjacency.
+    The sort key is (key_hash, row_hash, time-view) — 3 fixed u32 operands
+    instead of the full row (TPU sorts cost per 32-bit operand in both
+    runtime and compile time; this is the single hottest kernel). row_hash is
+    a u32 content hash of the val columns, so duplicate rows inside one key
+    group still land adjacent and annihilate; equal-row runs are then
+    confirmed by full-row adjacent comparison, which keeps correctness under
+    hash collisions — colliding distinct rows merely stay split across
+    entries, and every consumer treats a batch as a multiset of
+    (row, time, diff) updates (operators are linear in diff), so only perfect
+    annihilation (a capacity concern, not correctness) needs adjacency.
+    The time operand is the LOW 32 bits of the u64 time: distinct times
+    2^32 apart may interleave within a row's run, splitting it — again a
+    capacity concern only, and impossible for tick-counter times.
 
     Padding rows sort last (PAD_HASH) and keep diff 0, so they fold into one
     run that is masked back out. Output has the same capacity.
+
+    With ``compact=False`` the second (compaction) sort is skipped:
+    annihilated rows keep their hash/time in place with diff forced to 0, so
+    the output is STILL hash-sorted and probe-able but dead rows occupy
+    interior slots. Use for probe streams and operator outputs — anything not
+    about to be capacity-shrunk (`with_capacity` truncation needs live rows
+    in front, so arrangement level contents keep compact=True). Dead rows
+    are inert everywhere (consumers test diff != 0) but DO widen join
+    candidate ranges, so arrangements should stay compacted.
     """
     from ..repr.hashing import hash_columns
 
@@ -56,7 +70,9 @@ def consolidate(batch: UpdateBatch) -> UpdateBatch:
         row_hash = hash_columns(batch.vals)
     else:
         row_hash = jnp.zeros_like(batch.hashes)
-    order = jnp.lexsort((batch.times, row_hash, batch.hashes))
+    order = jnp.lexsort(
+        (batch.times.astype(jnp.uint32), row_hash, batch.hashes)
+    )
     b = batch.permute(order)
 
     cmp_cols = [b.hashes, *b.keys, *b.vals, b.times]
@@ -67,11 +83,14 @@ def consolidate(batch: UpdateBatch) -> UpdateBatch:
     diff_out = jnp.where(run_start, sums[seg], 0)
 
     live = run_start & (diff_out != 0) & (b.hashes != PAD_HASH)
+    diffs = jnp.where(live, diff_out, 0)
+    if not compact:
+        return UpdateBatch(b.hashes, b.keys, b.vals, b.times, diffs)
+
     hashes = jnp.where(live, b.hashes, PAD_HASH)
     keys = tuple(jnp.where(live, k, jnp.zeros_like(k)) for k in b.keys)
     vals = tuple(jnp.where(live, v, jnp.zeros_like(v)) for v in b.vals)
     times = jnp.where(live, b.times, PAD_TIME)
-    diffs = jnp.where(live, diff_out, 0)
 
     # Compact live rows to the front, preserving canonical order.
     perm = jnp.argsort(~live, stable=True)

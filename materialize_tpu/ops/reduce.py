@@ -35,7 +35,7 @@ _MAX_HASH_COLLISIONS = 4
 class AccumState:
     """Per-key accumulators: one row per live key, sorted by (hash, keys)."""
 
-    hashes: jnp.ndarray  # u64 [cap], PAD_HASH = padding
+    hashes: jnp.ndarray  # u32 [cap], PAD_HASH = padding
     keys: tuple  # key columns [cap]
     accums: tuple  # one accumulator column per aggregate [cap]
     nrows: jnp.ndarray  # i64 [cap] — group size (sum of diffs)
@@ -61,7 +61,7 @@ class AccumState:
     @staticmethod
     def empty(cap: int, key_dtypes, accum_dtypes) -> "AccumState":
         return AccumState(
-            hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint64),
+            hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint32),
             keys=tuple(jnp.zeros((cap,), dtype=dt) for dt in key_dtypes),
             accums=tuple(jnp.zeros((cap,), dtype=dt) for dt in accum_dtypes),
             nrows=jnp.zeros((cap,), dtype=jnp.int64),

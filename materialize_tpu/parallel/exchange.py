@@ -33,7 +33,7 @@ def route_to_buckets(batch: UpdateBatch, n_dest: int, bucket_cap: int):
     """
     cap = batch.cap
     live = batch.live
-    dest = (batch.hashes % jnp.uint64(n_dest)).astype(jnp.int32)
+    dest = (batch.hashes % jnp.uint32(n_dest)).astype(jnp.int32)
     key = jnp.where(live, dest, n_dest)  # dead rows to a discard bucket
     order = jnp.argsort(key, stable=True)
     key_s = key[order]

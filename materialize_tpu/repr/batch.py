@@ -1,7 +1,7 @@
 """UpdateBatch — the universal device currency of the engine.
 
 A batch is a fixed-capacity structure-of-arrays of update triples
-``(key_cols, val_cols, time, diff)`` plus a precomputed u64 key hash, the TPU
+``(key_cols, val_cols, time, diff)`` plus a precomputed u32 key hash, the TPU
 re-design of the reference's update-triple collections
 (doc/developer/change-data-capture.md:5-13) and of differential's `Batch`.
 
@@ -37,7 +37,7 @@ def bucket_cap(n: int, minimum: int = MIN_CAP) -> int:
 @jax.tree_util.register_pytree_node_class
 @dataclass
 class UpdateBatch:
-    hashes: jnp.ndarray  # u64 [cap] — hash of key columns (PAD_HASH = padding)
+    hashes: jnp.ndarray  # u32 [cap] — hash of key columns (PAD_HASH = padding)
     keys: tuple  # tuple of [cap] arrays (possibly empty tuple)
     vals: tuple  # tuple of [cap] arrays
     times: jnp.ndarray  # u64 [cap]
@@ -55,7 +55,7 @@ class UpdateBatch:
     @staticmethod
     def empty(cap: int, key_dtypes=(), val_dtypes=()) -> "UpdateBatch":
         return UpdateBatch(
-            hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint64),
+            hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint32),
             keys=tuple(jnp.zeros((cap,), dtype=dt) for dt in key_dtypes),
             vals=tuple(jnp.zeros((cap,), dtype=dt) for dt in val_dtypes),
             times=jnp.full((cap,), PAD_TIME, dtype=jnp.uint64),
@@ -75,7 +75,7 @@ class UpdateBatch:
         if key_cols:
             hashes = hash_columns(key_cols)
         else:
-            hashes = jnp.zeros((n,), dtype=jnp.uint64)
+            hashes = jnp.zeros((n,), dtype=jnp.uint32)
         b = UpdateBatch(hashes, key_cols, val_cols, times, diffs)
         return b.with_capacity(cap)
 

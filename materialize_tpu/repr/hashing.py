@@ -1,10 +1,20 @@
-"""Deterministic 64-bit row hashing on device.
+"""Deterministic 32-bit row hashing on device.
 
-Every update batch carries a u64 hash of its key columns; arrangements sort by
+Every update batch carries a u32 hash of its key columns; arrangements sort by
 it, exchanges shard by it, joins probe by it. Collisions are handled (kernels
 re-check key equality on gather), so the hash only needs uniformity.
 Plays the role of the reference's key-hash exchange pacts
 (src/timely-util/src/pact.rs and differential's `Hashable`).
+
+u32, not u64, on purpose: the TPU VPU is a 32-bit machine — XLA splits every
+u64 op into u32 pairs (X64SplitLow custom-calls, r2 profile), so u64 hashes
+double the cost of the three hottest kernels (sort keys, searchsorted probes,
+exchange routing) and double the hash column's HBM footprint. Collisions rise
+(~n²/2³³ colliding pairs) but every kernel already verifies true key equality
+on gather, consolidation confirms runs by full-row compare, and the reduce
+lookup's bucket-scan overflow is detected and surfaced as an error — so a
+collision costs capacity, never correctness. Mixing still runs through
+splitmix64 (u64) per column for quality; only the final fold is 32-bit.
 """
 
 from __future__ import annotations
@@ -19,7 +29,7 @@ _C3 = np.uint64(0x94D049BB133111EB)
 
 # Reserved sentinel: padding rows hash to PAD_HASH and sort to the end of
 # every batch. Real hashes are clamped below it.
-PAD_HASH = np.uint64(0xFFFFFFFFFFFFFFFF)
+PAD_HASH = np.uint32(0xFFFFFFFF)
 
 
 def splitmix64(x: jnp.ndarray) -> jnp.ndarray:
@@ -62,7 +72,7 @@ def jax_bitcast_u32(f: jnp.ndarray) -> jnp.ndarray:
 
 
 def hash_columns(cols: tuple[jnp.ndarray, ...]) -> jnp.ndarray:
-    """Combine key columns into one u64 hash per row, clamped below PAD_HASH."""
+    """Combine key columns into one u32 hash per row, clamped below PAD_HASH."""
     if not cols:
         # Keyless (global) groups: constant hash 0 routes everything together.
         raise ValueError("hash_columns needs at least one column; use zeros for keyless")
@@ -70,7 +80,8 @@ def hash_columns(cols: tuple[jnp.ndarray, ...]) -> jnp.ndarray:
     for i, col in enumerate(cols):
         salt = np.uint64(((i + 1) * int(_C1)) % (1 << 64))
         h = splitmix64(h ^ splitmix64(_col_to_u64(col) + salt))
-    return jnp.where(h == PAD_HASH, PAD_HASH - np.uint64(1), h)
+    h32 = (h ^ (h >> np.uint64(32))).astype(jnp.uint32)  # fold to 32 bits
+    return jnp.where(h32 == PAD_HASH, PAD_HASH - np.uint32(1), h32)
 
 
 def hash_columns_np(cols) -> np.ndarray:

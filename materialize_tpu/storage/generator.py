@@ -141,8 +141,15 @@ class TpchGenerator:
     fixed-point i64 cents; dates are day numbers (date_num).
     """
 
-    def __init__(self, sf: float = 0.01, seed: int = 0, segment_codes=None):
+    def __init__(self, sf: float = 0.01, seed: int = 0, segment_codes=None,
+                 val_dtype=np.int64):
         self.sf = sf
+        # Device-batch value dtype. The SQL path keeps i64 (table descs are
+        # int64); the bench path passes int32 — every TPC-H column fits
+        # (orderkey < 2^31 through SF100, cents < 10^7, dates < 2557) and the
+        # TPU VPU is a 32-bit machine, so i32 halves gather/sort bandwidth.
+        # Host mirrors stay i64; the cast happens at batch build.
+        self.val_dtype = np.dtype(val_dtype)
         self.rng = np.random.default_rng(seed)
         # c_mktsegment: raw 0..4 indices into _SEGMENTS by default; a caller
         # with a string dictionary passes its codes so SQL 'BUILDING' matches
@@ -204,7 +211,7 @@ class TpchGenerator:
         t = self.initial()
         out = {}
         for name in ("customer", "orders", "lineitem", "part"):
-            cols = getattr(t, name)
+            cols = tuple(c.astype(self.val_dtype) for c in getattr(t, name))
             n = len(cols[0])
             out[name] = UpdateBatch.build((), cols, np.full(n, tick), np.ones(n, dtype=np.int64))
         return out
@@ -261,6 +268,8 @@ class TpchGenerator:
         l_all = tuple(np.concatenate([p[i] for p in l_out]) for i in range(6))
         od = np.concatenate(o_diffs)
         ld = np.concatenate(l_diffs)
+        o_all = tuple(c.astype(self.val_dtype) for c in o_all)
+        l_all = tuple(c.astype(self.val_dtype) for c in l_all)
         return {
             "orders": UpdateBatch.build((), o_all, np.full(len(od), tick), od),
             "lineitem": UpdateBatch.build((), l_all, np.full(len(ld), tick), ld),

@@ -60,13 +60,19 @@ def test_route_and_exchange_roundtrip():
         assert (owned == d).all()
 
 
-@pytest.mark.parametrize("n_shards", [1, 4])
-def test_fused_q3_matches_oracle(n_shards):
-    # delta sized so tick-based hydration fits in L0 (= 4*delta per shard)
+@pytest.mark.parametrize(
+    "n_shards,val_dtype",
+    [(1, "int64"), (1, "int32"), (4, "int32")],
+)
+def test_fused_q3_matches_oracle(n_shards, val_dtype):
+    # delta sized so tick-based hydration fits in L0 (= 4*delta per shard);
+    # int32 is the bench-path value dtype (bench.py) and must match the
+    # oracle exactly, not just approximately
     delta = 1 << 10 if n_shards == 1 else 1 << 8
     caps = Q3Caps(cust=1 << 10, orders=1 << 10, lineitem=1 << 12, delta=delta,
-                  bucket=1 << 9, join_out=1 << 12, groups=1 << 11)
-    gen = TpchGenerator(sf=0.0005, seed=11)
+                  bucket=1 << 9, join_out=1 << 12, groups=1 << 11,
+                  val_dtype=val_dtype)
+    gen = TpchGenerator(sf=0.0005, seed=11, val_dtype=np.dtype(val_dtype))
     init = gen.initial_batches(1)
 
     def pad_to(b, cap):
@@ -94,9 +100,9 @@ def test_fused_q3_matches_oracle(n_shards):
         for data, tt, d in out.to_rows():
             out_acc[data] = out_acc.get(data, 0) + d
 
-    empty_c = UpdateBatch.empty(8 * n_shards, (), (np.dtype(np.int64),) * 3)
-    empty_o = UpdateBatch.empty(8 * n_shards, (), (np.dtype(np.int64),) * 4)
-    empty_l = UpdateBatch.empty(8 * n_shards, (), (np.dtype(np.int64),) * 6)
+    empty_c = UpdateBatch.empty(8 * n_shards, (), (np.dtype(val_dtype),) * 3)
+    empty_o = UpdateBatch.empty(8 * n_shards, (), (np.dtype(val_dtype),) * 4)
+    empty_l = UpdateBatch.empty(8 * n_shards, (), (np.dtype(val_dtype),) * 6)
 
     run(1, init["customer"], init["orders"], init["lineitem"])
     for t in range(2, 5):
