@@ -24,12 +24,18 @@ from ..repr.batch import UpdateBatch, bucket_cap
 from ..repr.hashing import hash_columns
 
 
-def arrange_batch(batch: UpdateBatch, key_cols: tuple[int, ...]) -> UpdateBatch:
+def arrange_batch(
+    batch: UpdateBatch, key_cols: tuple[int, ...], compact: bool = True
+) -> UpdateBatch:
     """Key a raw batch by the given val-column indices and canonicalize it.
 
     The analogue of the ArrangeBy LIR operator's batch construction
     (reference: src/compute/src/render.rs:1303). Key columns are *copied*
     into `keys` (vals stay the full row) and the hash is recomputed.
+
+    `compact=False` skips the compaction sort (see ops/consolidate.py):
+    right for probe streams and LSM-insert deltas inside fused ticks, which
+    never capacity-truncate the batch; spine contents keep the default.
     """
     keys = tuple(batch.vals[i] for i in key_cols)
     if keys:
@@ -39,7 +45,7 @@ def arrange_batch(batch: UpdateBatch, key_cols: tuple[int, ...]) -> UpdateBatch:
     else:
         hashes = jnp.where(batch.live, jnp.zeros_like(batch.hashes), batch.hashes)
     keyed = UpdateBatch(hashes, keys, batch.vals, batch.times, batch.diffs)
-    return consolidate(keyed)
+    return consolidate(keyed, compact=compact)
 
 
 @dataclass

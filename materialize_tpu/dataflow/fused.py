@@ -64,6 +64,9 @@ class FusedCaps:
     """Static capacities for one compiled dataflow (all powers of two).
 
     `scale` doubles every capacity at once — the overflow-retry knob.
+    On a mesh these are PER-SHARD capacities; `bucket` is the per-destination
+    exchange bucket (0 = auto: equal to `delta`, which is skew-proof for a
+    delta-sized send).
     """
 
     delta: int = 1 << 10  # per-source per-tick delta rows
@@ -71,6 +74,7 @@ class FusedCaps:
     groups: int = 1 << 13  # top accumulator-table level per reduce
     join_out: int = 1 << 12  # join output rows per level pair
     gather: int = 1 << 12  # topk gathered group contents per level
+    bucket: int = 0  # exchange bucket per destination (0 = delta)
     levels: int = 3
     ratio: int = 8
 
@@ -81,6 +85,7 @@ class FusedCaps:
             groups=self.groups * k,
             join_out=self.join_out * k,
             gather=self.gather * k,
+            bucket=self.bucket * k,
             levels=self.levels,
             ratio=self.ratio,
         )
@@ -111,11 +116,26 @@ class _Ctx:
 
 
 class FusedCompiler:
-    """Walks LIR plans; builds the state template and the traceable tick."""
+    """Walks LIR plans; builds the state template and the traceable tick.
 
-    def __init__(self, desc: lir.DataflowDescription, caps: FusedCaps):
+    With `axis_name` set (shard_map over a mesh axis), every batch headed for
+    stateful-operator state is first exchanged to the shard owning its key
+    hash (all_to_all riding ICI) — the timely worker-exchange pact placement
+    (reference: src/timely-util/src/pact.rs): exchange before ArrangeBy-like
+    state touch, never after stateless MFPs.
+    """
+
+    def __init__(
+        self,
+        desc: lir.DataflowDescription,
+        caps: FusedCaps,
+        axis_name: str | None = None,
+        n_shards: int = 1,
+    ):
         self.desc = desc
         self.caps = caps
+        self.axis_name = axis_name
+        self.n_shards = n_shards
         self.dtypes: dict[str, tuple] = {
             sid: tuple(dts) for sid, dts in desc.source_imports.items()
         }
@@ -334,6 +354,20 @@ class FusedCompiler:
         ctx.overflow.append(merged.count() > out_cap)
         return merged.with_capacity(out_cap)
 
+    def _exchanged(self, keyed: UpdateBatch, ctx: _Ctx) -> UpdateBatch:
+        """Route a keyed batch to the shard owning its hash (no-op off-mesh).
+
+        Every stateful operator's input passes through here so co-keyed rows
+        are co-located before probing/inserting sharded arrangements."""
+        if self.axis_name is None:
+            return keyed
+        from ..parallel.exchange import exchange
+
+        bucket = self.caps.bucket or self.caps.delta
+        out, f = exchange(keyed, self.axis_name, self.n_shards, bucket)
+        ctx.overflow.append(f)
+        return consolidate(out, compact=False)
+
     def _emit_join(self, e: lir.Join, ctx: _Ctx) -> UpdateBatch:
         caps = self.caps
         jcaps = (caps.join_out,) * caps.levels
@@ -345,8 +379,10 @@ class FusedCompiler:
                 lpath, rpath = slots[si]
                 L = ctx.state_in[lpath]
                 R = ctx.state_in[rpath]
-                dlk = arrange_batch(stream, st.stream_key)
-                drk = arrange_batch(deltas[si + 1], st.lookup_key)
+                dlk = self._exchanged(arrange_batch(stream, st.stream_key), ctx)
+                drk = self._exchanged(
+                    arrange_batch(deltas[si + 1], st.lookup_key), ctx
+                )
                 outs, f1 = lsm_join(dlk, R, jcaps)
                 outs2, f2 = lsm_join(drk, L, jcaps, swap=True)
                 dd = join_materialize(dlk, drk, caps.join_out)
@@ -370,7 +406,9 @@ class FusedCompiler:
             for k, path_stages in enumerate(e.plan.paths):
                 stream = deltas[k]
                 for st in path_stages:
-                    probe = arrange_batch(stream, st.stream_key)
+                    probe = self._exchanged(
+                        arrange_batch(stream, st.stream_key), ctx
+                    )
                     lsm = cur[(st.other_input, st.lookup_key)]
                     parts, f = lsm_join(probe, lsm, (caps.join_out,) * caps.levels)
                     ctx.overflow.append(f)
@@ -381,7 +419,9 @@ class FusedCompiler:
                 # publish input k's delta into its arrangements
                 for (inp, key), path in arrs.items():
                     if inp == k:
-                        keyed = arrange_batch(deltas[k], key)
+                        keyed = self._exchanged(
+                            arrange_batch(deltas[k], key), ctx
+                        )
                         newA, f = lsm_insert(
                             cur[(inp, key)], keyed, ctx.time, caps.ratio,
                             since=ctx.since,
@@ -399,6 +439,8 @@ class FusedCompiler:
         _kind, path = self._emitters[id(e)]
         lsm: LsmAccums = ctx.state_in[path]
         inp = self._emit(e.input, ctx)
+        if self.axis_name is not None:
+            inp = self._exchanged(arrange_batch(inp, e.key_cols), ctx)
         raw, errs = _contributions(inp, e.key_cols, e.aggs)
         ctx.errs.append(errs)
         contrib = consolidate_accums(raw)
@@ -421,6 +463,8 @@ class FusedCompiler:
         _kind, path = self._emitters[id(e)]
         lsm: LsmAccums = ctx.state_in[path]
         inp = self._emit(e.input, ctx)
+        if self.axis_name is not None:
+            inp = self._exchanged(arrange_batch(inp, tuple(key_cols)), ctx)
         raw, _errs = _contributions(inp, tuple(key_cols), ())
         contrib = consolidate_accums(raw)
         _accs, old_n, missed = accum_lsm_lookup(lsm, contrib)
@@ -448,7 +492,7 @@ class FusedCompiler:
         _kind, path = self._emitters[id(e)]
         lsm: LsmBatches = ctx.state_in[path]
         inp = self._emit(e.input, ctx)
-        keyed = arrange_batch(inp, e.plan.group_cols)
+        keyed = self._exchanged(arrange_batch(inp, e.plan.group_cols), ctx)
         probes = distinct_keys(keyed)
         old_rows, f1 = _gather_lsm(probes, lsm, caps.gather, ctx.time)
         new_lsm, f2 = lsm_insert(lsm, keyed, ctx.time, caps.ratio, since=ctx.since)
@@ -538,14 +582,31 @@ class FusedDataflow:
     Same host interface (`step`, `peek`, `compact`, `frontier`), but the
     whole tick is one jitted program. Overflow retries re-run the SAME tick
     from the pre-tick state with doubled capacities (lossless by design).
+
+    With `mesh`, the tick runs under shard_map over `axis_name`: every
+    arrangement and accumulator table is hash-sharded across the mesh
+    (state arrays carry n_shards× the per-shard capacity on axis 0) and
+    keyed streams are exchanged to their hash owner before every stateful
+    operator — the SQL engine's multi-worker execution mode, replacing the
+    reference's intra-replica timely worker sharding
+    (src/cluster/src/communication.rs:100) with XLA collectives over ICI.
     """
 
-    def __init__(self, desc: lir.DataflowDescription, caps: Optional[FusedCaps] = None):
+    def __init__(
+        self,
+        desc: lir.DataflowDescription,
+        caps: Optional[FusedCaps] = None,
+        mesh=None,
+        axis_name: str = "workers",
+    ):
         self.desc = desc
         self.caps = caps or FusedCaps()
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_shards = int(mesh.shape[axis_name]) if mesh is not None else 1
         self._scale = 1
         self._build()
-        self.state = dict(self.compiler.state_template)
+        self.state = self._tiled_template()
         self.index_traces: dict[str, Arrangement] = {}
         self.index_errs: dict[str, Arrangement] = {}
         for idx_id, (obj_id, key_cols) in desc.index_exports.items():

@@ -131,9 +131,13 @@ _AGGS = (AggregateExpr("sum", Column(3)),)
 
 
 def _maybe_exchange(batch, axis_name, n_shards, bucket):
+    """Route to the hash owner, then re-canonicalize (rows from n senders
+    interleave). Off-mesh this is the identity: the input is already
+    consolidated by arrange_batch."""
     if axis_name is None:
         return batch, jnp.asarray(False)
-    return exchange(batch, axis_name, n_shards, bucket)
+    out, f = exchange(batch, axis_name, n_shards, bucket)
+    return consolidate(out, compact=False), f
 
 
 def _project_cols(batch: UpdateBatch, perm) -> UpdateBatch:
@@ -181,9 +185,11 @@ def q3_tick(
     fo, _ = _ORD_MFP.apply(d_ord)
     fl, _ = _LI_MFP.apply(d_li)
 
-    do_ck = arrange_batch(fo, (1,))
-    do_ok = arrange_batch(fo, (0,))
-    dl = arrange_batch(fl, (0,))
+    # probe/insert streams skip the compaction sort throughout: dead rows
+    # stay inert and these batches are never capacity-shrunk (consolidate.py)
+    do_ck = arrange_batch(fo, (1,), compact=False)
+    do_ok = arrange_batch(fo, (0,), compact=False)
+    dl = arrange_batch(fl, (0,), compact=False)
 
     do_ck, f = _maybe_exchange(do_ck, axis_name, n_shards, caps.bucket)
     track(f)
@@ -191,26 +197,20 @@ def q3_tick(
     track(f)
     dl, f = _maybe_exchange(dl, axis_name, n_shards, caps.bucket)
     track(f)
-    # probe streams: skip the compaction sort — dead rows stay inert and
-    # these batches are never capacity-shrunk (ops/consolidate.py)
-    do_ck = consolidate(do_ck, compact=False)
-    do_ok = consolidate(do_ok, compact=False)
-    dl = consolidate(dl, compact=False)
 
     outs = []
     if with_cust:
         fc, _ = _CUST_MFP.apply(d_cust)
-        dc = arrange_batch(fc, (0,))
+        dc = arrange_batch(fc, (0,), compact=False)
         dc, f = _maybe_exchange(dc, axis_name, n_shards, caps.bucket)
         track(f)
-        dc = consolidate(dc, compact=False)
         # path 0: d customer ⋈ orders(ck) ⋈ lineitem(ok)
         s0s, f = lsm_join(dc, state.ord_by_ck, jcaps)
         track(f)
-        s0 = arrange_batch(_concat_all(s0s), (1,))  # key ok
+        s0 = arrange_batch(_concat_all(s0s), (1,), compact=False)  # key ok
         s0, f = _maybe_exchange(s0, axis_name, n_shards, caps.bucket)
         track(f)
-        s0s, f = lsm_join(consolidate(s0, compact=False), state.li_by_ok, jcaps)
+        s0s, f = lsm_join(s0, state.li_by_ok, jcaps)
         track(f)
         outs += s0s  # (ck | ok,ck,od,sp | lk,ep,dc) = canonical
         new_cust, f = lsm_insert(state.cust_by_ck, dc, time, RATIO)
@@ -221,10 +221,10 @@ def q3_tick(
     # path 1: d orders ⋈ customer(ck) ⋈ lineitem(ok)
     s1s, f = lsm_join(do_ck, new_cust, jcaps)
     track(f)
-    s1 = arrange_batch(_concat_all(s1s), (0,))  # stream (ok,ck,od,sp | ck): key ok
+    s1 = arrange_batch(_concat_all(s1s), (0,), compact=False)  # (ok,ck,od,sp | ck): key ok
     s1, f = _maybe_exchange(s1, axis_name, n_shards, caps.bucket)
     track(f)
-    s1s, f = lsm_join(consolidate(s1, compact=False), state.li_by_ok, jcaps)
+    s1s, f = lsm_join(s1, state.li_by_ok, jcaps)
     track(f)
     outs += [_project_cols(s, (4, 0, 1, 2, 3, 5, 6, 7)) for s in s1s]
     new_ord_ck, f = lsm_insert(state.ord_by_ck, do_ck, time, RATIO)
@@ -235,21 +235,20 @@ def q3_tick(
     # path 2: d lineitem ⋈ orders(ok) ⋈ customer(ck)
     s2s, f = lsm_join(dl, new_ord_ok, jcaps)
     track(f)
-    s2 = arrange_batch(_concat_all(s2s), (4,))  # stream (lk,ep,dc | ok,ck,od,sp): key ck
+    s2 = arrange_batch(_concat_all(s2s), (4,), compact=False)  # (lk,ep,dc | ok,ck,od,sp): key ck
     s2, f = _maybe_exchange(s2, axis_name, n_shards, caps.bucket)
     track(f)
-    s2s, f = lsm_join(consolidate(s2, compact=False), new_cust, jcaps)
+    s2s, f = lsm_join(s2, new_cust, jcaps)
     track(f)
     outs += [_project_cols(s, (7, 3, 4, 5, 6, 0, 1, 2)) for s in s2s]
     new_li, f = lsm_insert(state.li_by_ok, dl, time, RATIO)
     track(f)
 
     # closure + reduce
-    joined, errs1 = _CLOSURE.apply(consolidate(_concat_all(outs), compact=False))
-    grouped = arrange_batch(joined, (0, 1, 2))
+    joined, errs1 = _CLOSURE.apply(_concat_all(outs))
+    grouped = arrange_batch(joined, (0, 1, 2), compact=False)
     grouped, f = _maybe_exchange(grouped, axis_name, n_shards, caps.bucket)
     track(f)
-    grouped = consolidate(grouped, compact=False)
 
     raw_contrib, errs2 = _contributions(grouped, (0, 1, 2), _AGGS)
     contrib = consolidate_accums(raw_contrib)
