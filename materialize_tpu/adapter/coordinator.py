@@ -73,8 +73,11 @@ class Coordinator:
 
     def __init__(
         self, data_dir: str | None = None, blob=None, consensus=None,
-        preflight: bool = False,
+        preflight: bool = False, mesh=None,
     ) -> None:
+        # with `mesh`, fused dataflows run shard_map-sharded over its
+        # `workers` axis (multi-worker SQL execution; parallel/exchange.py)
+        self.mesh = mesh
         self.catalog = Catalog()
         self.oracle = TimestampOracle()
         self.storage: dict[str, StorageCollection] = {}
@@ -595,7 +598,7 @@ class Coordinator:
             from ..dataflow.fused import FusedDataflow, FusedUnsupported
 
             try:
-                df = FusedDataflow(desc)
+                df = FusedDataflow(desc, mesh=self.mesh)
                 if snaps:
                     # pre-size so the hydration tick doesn't ladder through
                     # doubling retries on large input snapshots

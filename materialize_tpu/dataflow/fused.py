@@ -536,6 +536,28 @@ def _project_cols(batch: UpdateBatch, perm) -> UpdateBatch:
     )
 
 
+def _grow_rows(have, want_cap: int, n_shards: int):
+    """Grow a level (UpdateBatch or AccumState) to `want_cap` total rows,
+    padding each of the n per-shard slices at its own tail."""
+    if have.cap == want_cap:
+        return have
+    if n_shards == 1:
+        return have.with_capacity(want_cap)
+    per_have = have.cap // n_shards
+    per_want = want_cap // n_shards
+    kind = type(have)
+    shards = [
+        jax.tree_util.tree_map(
+            lambda a, i=i: a[i * per_have : (i + 1) * per_have], have
+        ).with_capacity(per_want)
+        for i in range(n_shards)
+    ]
+    acc = shards[0]
+    for s in shards[1:]:
+        acc = kind.concat(acc, s)
+    return acc
+
+
 def _accum_dtypes_linear(in_dts: list, stage_i: int) -> list:
     """Column dtypes of the accumulated stream entering stage i."""
     cols: list = []
@@ -621,7 +643,13 @@ class FusedDataflow:
 
     # -- compile ------------------------------------------------------------
     def _build(self) -> None:
-        self.compiler = FusedCompiler(self.desc, self.caps.scaled(self._scale))
+        axis = self.axis_name if self.mesh is not None else None
+        self.compiler = FusedCompiler(
+            self.desc,
+            self.caps.scaled(self._scale),
+            axis_name=axis,
+            n_shards=self.n_shards,
+        )
         self.consts: dict[str, lir.Constant] = {}
         for bd in self.desc.objects_to_build:
             _collect_constants(bd.plan, self.consts)
@@ -651,24 +679,65 @@ class FusedDataflow:
                 [outs[bd.id].count() for bd in self.desc.objects_to_build]
                 + [errs.count()]
             )
-            return ctx.state_out, outs, errs, jnp.any(over), counts
+            # shape (1,)/(1,k) so shard_map concatenates per-device results
+            return (
+                ctx.state_out,
+                outs,
+                errs,
+                jnp.any(over).reshape((1,)),
+                counts.reshape((1, -1)),
+            )
 
-        self._tick = jax.jit(tick)
+        if self.mesh is None:
+            self._tick = jax.jit(tick)
+        else:
+            from jax.sharding import PartitionSpec as P
+
+            try:
+                shard_map = jax.shard_map
+            except AttributeError:  # older jax
+                from jax.experimental.shard_map import shard_map as _sm
+
+                shard_map = _sm
+            spec, rep = P(self.axis_name), P()
+            self._tick = jax.jit(
+                shard_map(
+                    tick,
+                    mesh=self.mesh,
+                    in_specs=(spec, spec, rep, rep),
+                    out_specs=(spec, spec, spec, spec, spec),
+                )
+            )
+
+    def _tiled_template(self) -> dict:
+        """State at GLOBAL shape: per-shard template tiled n_shards× on axis 0
+        (shard_map splits it evenly, giving each shard its per-shard slice)."""
+        tmpl = dict(self.compiler.state_template)
+        if self.n_shards == 1:
+            return tmpl
+        n = self.n_shards
+        return jax.tree_util.tree_map(
+            lambda x: jnp.concatenate([x] * n, axis=0), tmpl
+        )
 
     def ensure_delta_capacity(self, n_rows: int) -> None:
         """Grow capacities (and recompile + migrate state) until a tick of
         `n_rows` input rows fits. Used for bulk hydration ticks and oversized
         inputs, avoiding the overflow-retry ladder."""
-        if self.caps.scaled(self._scale).delta >= max(n_rows, 1):
+        if self._delta_cap() >= max(n_rows, 1):
             return
-        while self.caps.scaled(self._scale).delta < n_rows:
+        while self._delta_cap() < n_rows:
             self._scale *= 2
         self._build()
         self._migrate_state()
 
     def _migrate_state(self) -> None:
-        """Pad existing state into the new (larger) capacity template."""
-        tmpl = self.compiler.state_template
+        """Pad existing state into the new (larger) capacity template.
+
+        On a mesh, growth must happen PER SHARD: each shard's slice pads at
+        its own tail, so live rows keep their owning shard after the resize
+        (a global tail-pad would shift every shard boundary)."""
+        tmpl = self._tiled_template()
         new_state = {}
         for path, t in tmpl.items():
             cur = self.state.get(path)
@@ -676,15 +745,19 @@ class FusedDataflow:
                 new_state[path] = t
                 continue
             new_levels = tuple(
-                have.with_capacity(want.cap)
+                _grow_rows(have, want.cap, self.n_shards)
                 for have, want in zip(cur.levels, t.levels)
             )
             new_state[path] = type(t)(new_levels)
         self.state = new_state
 
+    def _delta_cap(self) -> int:
+        """GLOBAL per-source delta capacity (n_shards × the per-shard cap)."""
+        return self.caps.scaled(self._scale).delta * self.n_shards
+
     # -- drive --------------------------------------------------------------
     def step(self, tick: int, source_deltas: dict[str, UpdateBatch]) -> dict:
-        delta_cap = self.caps.scaled(self._scale).delta
+        delta_cap = self._delta_cap()
         deltas: dict[str, UpdateBatch] = {}
         for sid, dts in self.desc.source_imports.items():
             b = source_deltas.get(sid)
@@ -703,7 +776,7 @@ class FusedDataflow:
         state2, outs, errs, over, counts = self._tick(
             self.state, deltas, np.uint64(tick), np.uint64(self.since)
         )
-        if bool(np.asarray(over)):
+        if bool(np.asarray(over).any()):
             # lossless retry: drop results, double capacities, re-run the
             # same tick from the unchanged pre-tick state
             self._scale *= 2
@@ -711,7 +784,7 @@ class FusedDataflow:
             self._migrate_state()
             return self.step(tick, source_deltas)
         self.state = state2
-        counts = np.asarray(counts)
+        counts = np.asarray(counts).sum(axis=0)  # (shards, k) -> (k,)
         # mark constants emitted only after a successful tick
         for cid, c in self.consts.items():
             if all(r[1] <= tick for r in c.rows):

@@ -32,6 +32,7 @@ def check(cs, sql):
     return r0.rows
 
 
+@pytest.mark.smoke
 def test_fused_reduce_sum_count():
     cs = coords()
     both(cs, "CREATE TABLE bids (auction int, amount int)")

@@ -22,6 +22,7 @@ from materialize_tpu.repr import PAD_HASH, UpdateBatch
 from materialize_tpu.storage import TpchGenerator
 
 
+@pytest.mark.smoke
 def test_route_and_exchange_roundtrip():
     """Every live row lands on the device owning hash % n, none are lost."""
     mesh = make_mesh(4)
@@ -123,3 +124,58 @@ def test_fused_q3_matches_oracle(n_shards, val_dtype):
 
 def _ceil_mult(n, m):
     return ((n + m - 1) // m) * m
+
+
+@pytest.mark.slow
+def test_sharded_fused_sql_matches_host_and_single():
+    """SQL-defined MV on a 4-shard mesh == single-device fused == host runtime.
+
+    The general engine's multi-worker mode (VERDICT r3 #3): SQL text → LIR →
+    FusedDataflow under shard_map, not the hand-built Q3 model."""
+    from materialize_tpu.adapter import Coordinator
+    from materialize_tpu.dataflow.fused import FusedDataflow
+
+    host = Coordinator()
+    single = Coordinator()
+    single.execute("ALTER SYSTEM SET enable_fused_render = true")
+    sharded = Coordinator(mesh=make_mesh(4))
+    sharded.execute("ALTER SYSTEM SET enable_fused_render = true")
+    cs = (host, single, sharded)
+
+    def both(sql):
+        return [c.execute(sql) for c in cs]
+
+    def check(sql):
+        r = both(sql)
+        assert sorted(r[0].rows) == sorted(r[1].rows) == sorted(r[2].rows), (
+            sql, r[0].rows, r[1].rows, r[2].rows,
+        )
+        return r[0].rows
+
+    both("CREATE TABLE c (ck int, seg int)")
+    both("CREATE TABLE o (ok int, ck int, od int)")
+    both("CREATE TABLE l (lk int, price int)")
+    both(
+        "CREATE MATERIALIZED VIEW q3 AS SELECT o.ok, sum(l.price), count(*) "
+        "FROM c, o, l WHERE c.ck = o.ck AND o.ok = l.lk AND c.seg = 1 "
+        "AND o.od < 50 GROUP BY o.ok"
+    )
+    # the sharded coordinator must actually be running a mesh FusedDataflow
+    dfs = [df for _g, df, _s in sharded.dataflows]
+    assert dfs and isinstance(dfs[0], FusedDataflow) and dfs[0].n_shards == 4
+
+    import random
+
+    rng = random.Random(23)
+    for i in range(5):
+        both(f"INSERT INTO c VALUES ({i}, {rng.randrange(2)})")
+        both(
+            f"INSERT INTO o VALUES ({i * 10}, {rng.randrange(5)}, "
+            f"{rng.randrange(100)})"
+        )
+        both(
+            f"INSERT INTO l VALUES ({rng.randrange(5) * 10}, {rng.randrange(500)})"
+        )
+        if i >= 2:
+            both(f"DELETE FROM l WHERE lk = {rng.randrange(5) * 10}")
+        check("SELECT * FROM q3")

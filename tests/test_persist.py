@@ -30,6 +30,7 @@ def mkshard(tmp_path=None):
     )
 
 
+@pytest.mark.smoke
 def test_append_and_snapshot():
     m = mkshard()
     m.compare_and_append(cols([1, 2], [0, 0], [1, 1]), 0, 1)
@@ -43,6 +44,7 @@ def test_append_and_snapshot():
     assert m.upper() == 2
 
 
+@pytest.mark.smoke
 def test_upper_mismatch_fences_stale_writer():
     m = mkshard()
     m.compare_and_append(cols([1], [0], [1]), 0, 1)

@@ -36,6 +36,7 @@ def test_group_by_sum_count(coord):
     assert r.rows == [(1, 15, 2), (2, 7, 1)]
 
 
+@pytest.mark.smoke
 def test_materialized_view_incremental(coord):
     coord.execute("CREATE TABLE bids (auction int, amount int)")
     coord.execute("INSERT INTO bids VALUES (1, 10)")
