@@ -719,6 +719,13 @@ class Coordinator:
             v = e.value if isinstance(e, ast.NumberLit) else str(e.value).lower()
             return self.catalog.dict.encode(str(v))
         if isinstance(e, ast.NumberLit):
+            if "e" in e.value or "E" in e.value:  # scientific notation
+                f = float(e.value)
+                if cdesc.typ == ColType.NUMERIC:
+                    return int(round(f * 10**cdesc.scale))
+                if cdesc.typ in (ColType.INT64, ColType.INT32):
+                    return int(f)
+                return f
             if cdesc.typ == ColType.NUMERIC:
                 if "." in e.value:
                     # sign applies to the WHOLE value: int('-1')*100 + 50 would
@@ -1616,14 +1623,16 @@ def _eval_scalar_on_row(e, row: list):
             y, m, d = civil_from_days_int(int(v))
             return {"extract_year": y, "extract_month": m, "extract_day": d}[e.func]
         if e.func == "sqrt":
-            return float(v) ** 0.5
+            # f32 like the device kernel (expr/scalar.py sqrt), so host
+            # fast-path peeks agree bit-for-bit with rendered dataflows
+            return float(np.sqrt(np.float32(v), dtype=np.float32))
         return {
             "neg": lambda: -v,
             "not": lambda: not v,
             "abs": lambda: abs(v),
             "cast_int64": lambda: int(v),
             "cast_int32": lambda: int(v),
-            "cast_float": lambda: float(v),
+            "cast_float": lambda: float(np.float32(v)),
             "is_true": lambda: bool(v),
         }[e.func]()
     if isinstance(e, s.CallBinary):
@@ -1643,15 +1652,25 @@ def _eval_scalar_on_row(e, row: list):
             return False
         if l is None or r is None:
             return None
+        # float arithmetic mirrors the device's f32 kernels exactly, so a
+        # fast-path peek and a rendered dataflow never disagree on a value
+        # (the FLOAT64 precision rule, repr/types.py)
+        fl = isinstance(l, float) or isinstance(r, float)
+
+        def f32(x):
+            return float(np.float32(x))
+
         if e.func in ("div", "floordiv"):
             if r == 0:
                 raise PlanError("division by zero")
+            if fl:
+                return f32(np.float32(l) / np.float32(r))
             q = abs(l) // abs(r)
             return -q if (l < 0) != (r < 0) else q
         return {
-            "add": lambda: l + r,
-            "sub": lambda: l - r,
-            "mul": lambda: l * r,
+            "add": lambda: f32(np.float32(l) + np.float32(r)) if fl else l + r,
+            "sub": lambda: f32(np.float32(l) - np.float32(r)) if fl else l - r,
+            "mul": lambda: f32(np.float32(l) * np.float32(r)) if fl else l * r,
             "mod": lambda: l - r * (abs(l) // abs(r)) * (1 if (l < 0) == (r < 0) else -1),
             "eq": lambda: l == r,
             "ne": lambda: l != r,

@@ -184,8 +184,10 @@ class FusedCompiler:
             ins = self.infer_dtypes(e.input)
             if e.distinct:
                 return tuple(ins[i] for i in e.key_cols)
+            from ..ops.reduce import agg_out_dtype
+
             return tuple(ins[i] for i in e.key_cols) + tuple(
-                np.dtype(a.accum_dtype) for a in e.aggs
+                agg_out_dtype(a) for a in e.aggs
             )
         if isinstance(e, lir.Join):
             from .runtime import _expr_dtype
@@ -448,7 +450,9 @@ class FusedCompiler:
         from ..ops.reduce import collision_errs
 
         ctx.errs.append(collision_errs(contrib, missed, ctx.time))
-        out = consolidate(_emit_output(contrib, old_accums, old_nrows, ctx.time))
+        out = consolidate(
+            _emit_output(contrib, old_accums, old_nrows, ctx.time, e.aggs)
+        )
         new_lsm, f = accum_lsm_insert(lsm, contrib, ctx.time, self.caps.ratio)
         ctx.overflow.append(f)
         ctx.state_out[path] = new_lsm

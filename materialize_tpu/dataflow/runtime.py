@@ -25,7 +25,7 @@ import numpy as np
 from ..arrangement.spine import Arrangement, arrange_batch
 from ..ops.consolidate import consolidate
 from ..ops.join import join_against
-from ..ops.reduce import AccumState, accumulable_step
+from ..ops.reduce import AccumState, accumulable_step, agg_out_dtype
 from ..ops.threshold import threshold_step
 from ..ops.topk import negate as negate_batch
 from ..ops.topk import topk_step
@@ -902,7 +902,7 @@ class Dataflow:
             if e.distinct:
                 return tuple(ins[i] for i in e.key_cols)
             return tuple(ins[i] for i in e.key_cols) + tuple(
-                np.dtype(a.accum_dtype) for a in e.aggs
+                agg_out_dtype(a) for a in e.aggs
             )
         if isinstance(e, lir.Join):
             cols = []

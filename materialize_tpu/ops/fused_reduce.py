@@ -53,7 +53,7 @@ def fused_mfp_reduce_step(
     errs2 = consolidate(
         UpdateBatch.concat(errs2, collision_errs(contrib, missed, time))
     )
-    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time))
+    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time, aggs))
     new_state = consolidate_accums(AccumState.concat(state, contrib))
     errs = errs2 if errs1 is None else consolidate(UpdateBatch.concat(errs1, errs2))
     return new_state, out, errs

@@ -25,9 +25,15 @@ from ..expr.scalar import ScalarExpr, eval_expr
 from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
 from ..repr.hashing import PAD_HASH, hash_columns
 
-# Beyond this many distinct keys sharing one 64-bit hash, lookups would miss;
-# with a uniform hash this needs ~2^32 keys per hash bucket to matter.
+# Fast-path scan width for hash-bucket lookups. u32 row hashes make small
+# buckets routine at scale (birthday collisions from ~2^16 keys), so lookups
+# scan 4 slots unconditionally and — only when some probe's bucket is larger
+# — re-scan at _WIDE_HASH_COLLISIONS under lax.cond (probe widening: the
+# wide path costs nothing unless triggered). A >64-deep bucket needs a
+# ~5-way u32 collision (P < 1e-11 at 60M uniform keys) and still errors
+# loudly rather than mis-aggregating.
 _MAX_HASH_COLLISIONS = 4
+_WIDE_HASH_COLLISIONS = 64
 
 
 @jax.tree_util.register_pytree_node_class
@@ -106,11 +112,32 @@ class AggregateExpr:
 
     Mirrors the accumulable subset of the reference's `AggregateFunc`
     (src/expr/src/relation/func.rs:1878).
+
+    `fixed_scale` > 0 marks a FLOAT sum accumulated in fixed point: each
+    input is scaled by 2**fixed_scale, rounded to the i64 accumulator, and
+    the emitted output column descales back to float32. Insert and retract
+    of the same value quantize identically, so retractions cancel EXACTLY —
+    an f32/f64 running sum would drift under churn. This is the reference's
+    float accumulation strategy (src/compute/src/render/reduce.rs:2067-2268
+    `Accum::Float` scales by 2^24 into a wide integer) rebuilt for the TPU's
+    integer units. Magnitude bound: |sum * 2^24| must fit i64, i.e. total
+    |sum| < ~5.5e11; overflow wraps (documented engine limit, vs the
+    reference's i128 headroom).
     """
 
     func: str
     expr: ScalarExpr
     accum_dtype: str = "int64"
+    fixed_scale: int = 0
+
+
+FLOAT_FIXED_SCALE = 24  # same quantum as the reference's float_scale
+
+
+def agg_out_dtype(a: AggregateExpr) -> np.dtype:
+    """Output column dtype of one aggregate (accumulator dtype, except
+    fixed-point float sums which descale to f32 on emission)."""
+    return np.dtype(np.float32) if a.fixed_scale else np.dtype(a.accum_dtype)
 
 
 @jax.jit
@@ -185,7 +212,14 @@ def _contributions(delta: UpdateBatch, key_cols: tuple[int, ...], aggs):
             v, nv, ev = eval_expr3(agg.expr, cols, n)
             err = jnp.maximum(err, ev)
             dt = np.dtype(agg.accum_dtype)
-            contrib = v.astype(dt) * delta.diffs.astype(dt)
+            if agg.fixed_scale:
+                # float sum: quantize once per value; exact under retraction
+                q = jnp.round(
+                    v.astype(jnp.float32) * np.float32(1 << agg.fixed_scale)
+                ).astype(dt)
+                contrib = q * delta.diffs.astype(dt)
+            else:
+                contrib = v.astype(dt) * delta.diffs.astype(dt)
             # NULL inputs contribute nothing (SQL sum ignores NULLs; an
             # all-NULL group reads 0 until typed NULL aggregates land)
             accums.append(jnp.where(nv, jnp.zeros_like(contrib), contrib))
@@ -220,23 +254,40 @@ def lookup_accums(state: AccumState, probe: AccumState):
     a wrong answer)."""
     lo = jnp.searchsorted(state.hashes, probe.hashes, side="left")
     hi = jnp.searchsorted(state.hashes, probe.hashes, side="right")
-    found = jnp.zeros(probe.hashes.shape, dtype=jnp.bool_)
-    idx = jnp.zeros(probe.hashes.shape, dtype=lo.dtype)
     from ..repr.hashing import value_view
 
-    for off in range(_MAX_HASH_COLLISIONS):
-        cand = jnp.clip(lo + off, 0, state.cap - 1)
-        eq = (lo + off) < hi
-        for pk, sk in zip(probe.keys, state.keys):
-            pv, sv = value_view(pk), value_view(sk)
-            eq = eq & (pv == sv[cand])
-        eq = eq & probe.live
-        take = eq & ~found
-        idx = jnp.where(take, cand, idx)
-        found = found | eq
+    def scan(width: int):
+        def body(off, carry):
+            found, idx = carry
+            cand = jnp.clip(lo + off, 0, state.cap - 1)
+            eq = (lo + off) < hi
+            for pk, sk in zip(probe.keys, state.keys):
+                pv, sv = value_view(pk), value_view(sk)
+                eq = eq & (pv == sv[cand])
+            eq = eq & probe.live
+            idx = jnp.where(eq & ~found, cand, idx)
+            return found | eq, idx
+
+        init = (
+            jnp.zeros(probe.hashes.shape, dtype=jnp.bool_),
+            jnp.zeros(probe.hashes.shape, dtype=lo.dtype),
+        )
+        return jax.lax.fori_loop(0, width, body, init)
+
+    found, idx = scan(_MAX_HASH_COLLISIONS)
+    narrow_missed = jnp.any(
+        probe.live & ~found & ((hi - lo) > _MAX_HASH_COLLISIONS)
+    )
+    # probe widening: the 64-slot re-scan traces into a lax.cond branch and
+    # executes only on the (rare) tick where some bucket outgrew 4 slots
+    found, idx = jax.lax.cond(
+        narrow_missed,
+        lambda: scan(_WIDE_HASH_COLLISIONS),
+        lambda: (found, idx),
+    )
     accums = tuple(jnp.where(found, a[idx], 0) for a in state.accums)
     nrows = jnp.where(found, state.nrows[idx], 0)
-    missed = probe.live & ~found & ((hi - lo) > _MAX_HASH_COLLISIONS)
+    missed = probe.live & ~found & ((hi - lo) > _WIDE_HASH_COLLISIONS)
     return found, accums, nrows, missed
 
 
@@ -256,22 +307,33 @@ def collision_errs(probe: AccumState, missed, time) -> UpdateBatch:
     )
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("aggs",))
 def _emit_output(
     delta_keys: AccumState,
     old_accums,
     old_nrows,
     time: jnp.ndarray,
+    aggs: tuple = (),
 ) -> UpdateBatch:
     """Self-correcting output: -old aggregate row, +new aggregate row per key.
 
     delta_keys holds the *delta* contributions; new = old + delta. Output rows
-    are (key cols ++ one col per aggregate), diff ±1 at `time`.
+    are (key cols ++ one col per aggregate), diff ±1 at `time`. With `aggs`,
+    fixed-point float accumulators descale back to f32 output columns.
     """
     cap = delta_keys.cap
     live = delta_keys.live
     new_accums = tuple(o + d for o, d in zip(old_accums, delta_keys.accums))
     new_nrows = old_nrows + delta_keys.nrows
+    scales = tuple(a.fixed_scale for a in aggs) if aggs else (0,) * len(new_accums)
+
+    def descale(a, s):
+        if not s:
+            return a
+        return a.astype(jnp.float32) / np.float32(1 << s)
+
+    old_accums = tuple(descale(a, s) for a, s in zip(old_accums, scales))
+    new_accums = tuple(descale(a, s) for a, s in zip(new_accums, scales))
 
     old_present = live & (old_nrows > 0)
     new_present = live & (new_nrows > 0)
@@ -315,7 +377,7 @@ def accumulable_step(
     raw_contrib, errs = _contributions(delta, key_cols, aggs)
     contrib = consolidate_accums(raw_contrib)
     _found, old_accums, old_nrows, missed = lookup_accums(state, contrib)
-    out = _emit_output(contrib, old_accums, old_nrows, time)
+    out = _emit_output(contrib, old_accums, old_nrows, time, aggs)
     from .consolidate import consolidate  # local import to avoid cycle
 
     out = consolidate(out)

@@ -26,7 +26,12 @@ class ColType(enum.Enum):
 
     INT64 = "int64"
     INT32 = "int32"
-    FLOAT64 = "float64"  # device-side f32 on TPU; f64 on CPU test meshes
+    # Device floats are f32 (no f64 ALU on TPU); value transport/compare is
+    # bit-exact, and SUM aggregates accumulate in i64 FIXED POINT (scale 2^24,
+    # ops/reduce.py AggregateExpr.fixed_scale) so retractions cancel exactly —
+    # the documented precision rule: doubles carry f32 precision, aggregates
+    # are deterministic and drift-free (tests/test_float_fidelity.py).
+    FLOAT64 = "float64"
     BOOL = "bool"
     STRING = "string"  # dictionary code (i64)
     TIMESTAMP = "timestamp"  # ms since epoch (i64), like mz Timestamp

@@ -80,6 +80,22 @@ def lex(sql: str) -> list[Token]:
                 if sql[j] == ".":
                     seen_dot = True
                 j += 1
+            # scientific notation: 1e30, 2.5E-3, 1e+6
+            if (
+                j < n
+                and sql[j] in "eE"
+                and (
+                    (j + 1 < n and sql[j + 1].isdigit())
+                    or (
+                        j + 2 < n
+                        and sql[j + 1] in "+-"
+                        and sql[j + 2].isdigit()
+                    )
+                )
+            ):
+                j += 2 if sql[j + 1] in "+-" else 1
+                while j < n and sql[j].isdigit():
+                    j += 1
             toks.append(Token("NUMBER", sql[i:j], i))
             i = j
             continue

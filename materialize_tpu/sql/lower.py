@@ -308,8 +308,20 @@ class Lowerer:
                     aggs.append(AggregateExpr("count", a.expr))
                 else:
                     dt = _expr_np_dtype(a.expr, in_dtypes)
-                    accum = "float32" if dt == F32 else "int64"
-                    aggs.append(AggregateExpr("sum", a.expr, accum))
+                    if dt == F32:
+                        # float sums accumulate in i64 fixed point so
+                        # retractions cancel exactly (ops/reduce.py
+                        # AggregateExpr docstring; reference Accum::Float)
+                        from ..ops.reduce import FLOAT_FIXED_SCALE
+
+                        aggs.append(
+                            AggregateExpr(
+                                "sum", a.expr, "int64",
+                                fixed_scale=FLOAT_FIXED_SCALE,
+                            )
+                        )
+                    else:
+                        aggs.append(AggregateExpr("sum", a.expr, "int64"))
             return lir.Reduce(lowered_in, key_cols=key, aggs=tuple(aggs))
 
         def hierarchical_part(agg_i: int):

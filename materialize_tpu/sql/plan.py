@@ -250,6 +250,12 @@ class Planner:
             i = scope.resolve(e.name, e.qualifier)
             return Column(i), scope.cols[i].typ
         if isinstance(e, ast.NumberLit):
+            if "e" in e.value or "E" in e.value:
+                # scientific notation is always a float literal (f32, the
+                # device float precision — repr/types.py FLOAT64 rule)
+                import numpy as _np
+
+                return Literal(float(_np.float32(e.value)), "float32"), FLOAT
             if "." in e.value:
                 intpart, frac = e.value.split(".")
                 scale = len(frac)

@@ -1,0 +1,86 @@
+"""u32 hash-bucket collision handling in accumulator lookups.
+
+u32 row hashes collide routinely at scale; lookup_accums scans 4 slots on
+the fast path and re-scans 64 under lax.cond when a bucket outgrows it
+(ops/reduce.py probe widening). These tests hand-build states with
+artificial collisions — natural ≥5-way u32 collisions are unobservably
+rare — to pin: deep buckets resolve correctly, and a >64-deep bucket still
+errors loudly instead of mis-aggregating.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from materialize_tpu.ops.reduce import (
+    _MAX_HASH_COLLISIONS,
+    _WIDE_HASH_COLLISIONS,
+    AccumState,
+    lookup_accums,
+)
+from materialize_tpu.repr.hashing import PAD_HASH
+
+
+def _bucket_state(n_keys: int, cap: int, hash_val: int = 5) -> AccumState:
+    """One hash bucket holding n_keys distinct keys (sorted by key)."""
+    hashes = np.full(cap, PAD_HASH, dtype=np.uint32)
+    keys = np.zeros(cap, dtype=np.int64)
+    accums = np.zeros(cap, dtype=np.int64)
+    nrows = np.zeros(cap, dtype=np.int64)
+    hashes[:n_keys] = hash_val
+    keys[:n_keys] = np.arange(n_keys)
+    accums[:n_keys] = 100 + np.arange(n_keys)
+    nrows[:n_keys] = 1
+    return AccumState(
+        jnp.asarray(hashes), (jnp.asarray(keys),), (jnp.asarray(accums),),
+        jnp.asarray(nrows),
+    )
+
+
+def _probe(key: int, cap: int = 8, hash_val: int = 5) -> AccumState:
+    hashes = np.full(cap, PAD_HASH, dtype=np.uint32)
+    keys = np.zeros(cap, dtype=np.int64)
+    hashes[0] = hash_val
+    keys[0] = key
+    return AccumState(
+        jnp.asarray(hashes), (jnp.asarray(keys),),
+        (jnp.asarray(np.zeros(cap, dtype=np.int64)),),
+        jnp.asarray(np.ones(cap, dtype=np.int64)),
+    )
+
+
+def test_narrow_scan_suffices_for_small_buckets():
+    state = _bucket_state(_MAX_HASH_COLLISIONS, cap=16)
+    for k in range(_MAX_HASH_COLLISIONS):
+        found, accums, nrows, missed = lookup_accums(state, _probe(k))
+        assert bool(found[0]) and int(accums[0][0]) == 100 + k
+        assert not bool(missed.any())
+
+
+def test_probe_widening_resolves_deep_bucket():
+    """A bucket one past the narrow scan — the exact case the round-3
+    verdict flagged — and all the way to the wide-scan limit."""
+    for depth in (_MAX_HASH_COLLISIONS + 1, 17, _WIDE_HASH_COLLISIONS):
+        state = _bucket_state(depth, cap=128)
+        # the LAST key in the bucket needs the full widened scan
+        found, accums, nrows, missed = lookup_accums(state, _probe(depth - 1))
+        assert bool(found[0]), f"depth {depth}: deep key not found"
+        assert int(accums[0][0]) == 100 + depth - 1
+        assert int(nrows[0]) == 1
+        assert not bool(missed.any()), f"depth {depth}: spurious miss"
+
+
+def test_absent_key_in_deep_bucket_is_not_found_not_missed():
+    state = _bucket_state(10, cap=64)
+    found, accums, nrows, missed = lookup_accums(state, _probe(999))
+    assert not bool(found[0])
+    assert int(nrows[0]) == 0
+    assert not bool(missed.any())  # bucket fits the wide scan: sound result
+
+
+def test_beyond_wide_scan_errors_loudly():
+    state = _bucket_state(_WIDE_HASH_COLLISIONS + 2, cap=128)
+    found, accums, nrows, missed = lookup_accums(
+        state, _probe(_WIDE_HASH_COLLISIONS + 1)
+    )
+    assert not bool(found[0])
+    assert bool(missed[0]), "unsound lookup must be flagged, never silent"

@@ -1,0 +1,95 @@
+"""FLOAT64 device-precision rule: f32 values, fixed-point drift-free sums.
+
+Doubles are f32 on device (repr/types.py ColType.FLOAT64); SUM over floats
+accumulates in i64 fixed point at scale 2^24 so insert/retract pairs cancel
+EXACTLY (ops/reduce.py AggregateExpr.fixed_scale — the TPU rebuild of the
+reference's Accum::Float, src/compute/src/render/reduce.rs:2067-2268).
+These tests pin that contract on the forced-f32 backend: churn never
+accumulates drift, and outputs match a host oracle applying the same
+quantization.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.ops.reduce import FLOAT_FIXED_SCALE
+
+SCALE = 1 << FLOAT_FIXED_SCALE
+
+
+def quantize(x: float) -> int:
+    """The engine's per-value quantization: f32 value scaled to the i64 grid."""
+    return int(round(float(np.float32(x) * np.float32(SCALE))))
+
+
+@pytest.mark.parametrize("fused", [False, True])
+def test_float_sum_retraction_is_exact(fused):
+    c = Coordinator()
+    if fused:
+        c.execute("ALTER SYSTEM SET enable_fused_render = true")
+    c.execute("CREATE TABLE m (sensor int, v double)")
+    c.execute(
+        "CREATE MATERIALIZED VIEW s AS SELECT sensor, sum(v), count(*) "
+        "FROM m GROUP BY sensor"
+    )
+    rng = random.Random(42)
+    live: list[tuple[int, float]] = []
+
+    def oracle():
+        acc: dict[int, list] = {}
+        for k, v in live:
+            e = acc.setdefault(k, [0, 0])
+            e[0] += quantize(v)
+            e[1] += 1
+        return {
+            k: (np.float32(s) / np.float32(SCALE), n) for k, (s, n) in acc.items()
+        }
+
+    for i in range(12):
+        if live and rng.random() < 0.45:
+            k, v = live.pop(rng.randrange(len(live)))
+            c.execute(f"DELETE FROM m WHERE sensor = {k} AND v = {v!r}")
+        k = rng.randrange(3)
+        v = round(rng.uniform(-100, 100), 3)
+        live.append((k, v))
+        c.execute(f"INSERT INTO m VALUES ({k}, {v!r})")
+        got = {
+            k: (np.float32(s), n) for k, s, n in c.execute("SELECT * FROM s").rows
+        }
+        want = oracle()
+        assert set(got) == set(want), (got, want)
+        for k in want:
+            # exact equality: same quantization, same integer accumulation
+            assert got[k][1] == want[k][1]
+            assert got[k][0] == pytest.approx(float(want[k][0]), abs=2.0 / SCALE)
+
+
+def test_float_sum_returns_exactly_after_churn():
+    """Insert a batch, churn unrelated values, delete the batch: the sum must
+    return EXACTLY to its prior reading (no f32 running-sum drift)."""
+    c = Coordinator()
+    c.execute("CREATE TABLE t (v double)")
+    c.execute("CREATE MATERIALIZED VIEW s AS SELECT sum(v) FROM t")
+    c.execute("INSERT INTO t VALUES (1.5), (2.25)")
+    before = c.execute("SELECT * FROM s").rows
+    # churn values whose f32 sums would drift a running accumulator
+    for v in (0.1, 0.2, 0.3, 1e7, -1e7, 3.3333333):
+        c.execute(f"INSERT INTO t VALUES ({v!r})")
+    for v in (0.1, 0.2, 0.3, 1e7, -1e7, 3.3333333):
+        c.execute(f"DELETE FROM t WHERE v = {v!r}")
+    after = c.execute("SELECT * FROM s").rows
+    assert after == before == [(3.75,)]
+
+
+def test_float_values_roundtrip_f32():
+    """Transport is bit-exact f32: what you insert is what you select."""
+    c = Coordinator()
+    c.execute("CREATE TABLE t (v double)")
+    vals = [0.1, -2.5, 1e30, 123.456]
+    c.execute("INSERT INTO t VALUES " + ", ".join(f"({v!r})" for v in vals))
+    got = sorted(v for (v,) in c.execute("SELECT * FROM t").rows)
+    want = sorted(float(np.float32(v)) for v in vals)
+    assert got == want
