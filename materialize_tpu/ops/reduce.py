@@ -268,10 +268,11 @@ def lookup_accums(state: AccumState, probe: AccumState):
             idx = jnp.where(eq & ~found, cand, idx)
             return found | eq, idx
 
-        init = (
-            jnp.zeros(probe.hashes.shape, dtype=jnp.bool_),
-            jnp.zeros(probe.hashes.shape, dtype=lo.dtype),
-        )
+        # Derive the carry init from already-traced operands so its varying
+        # manual axes match the body output under shard_map (a literal
+        # jnp.zeros init is unvarying while the body result varies over the
+        # mesh axis, which fails fori_loop's carry type check).
+        init = (probe.live & False, lo * 0)
         return jax.lax.fori_loop(0, width, body, init)
 
     found, idx = scan(_MAX_HASH_COLLISIONS)

@@ -63,7 +63,14 @@ def test_route_and_exchange_roundtrip():
 
 @pytest.mark.parametrize(
     "n_shards,val_dtype",
-    [(1, "int64"), (1, "int32"), (4, "int32")],
+    [
+        (1, "int64"),
+        (1, "int32"),
+        # the multi-shard case is in the smoke gate: it is the cheapest test
+        # that traces the fused engine under shard_map, which is where the
+        # round-4 carry-varyingness regression slipped through
+        pytest.param(4, "int32", marks=pytest.mark.smoke),
+    ],
 )
 def test_fused_q3_matches_oracle(n_shards, val_dtype):
     # delta sized so tick-based hydration fits in L0 (= 4*delta per shard);
@@ -126,6 +133,7 @@ def _ceil_mult(n, m):
     return ((n + m - 1) // m) * m
 
 
+@pytest.mark.smoke
 @pytest.mark.slow
 def test_sharded_fused_sql_matches_host_and_single():
     """SQL-defined MV on a 4-shard mesh == single-device fused == host runtime.
