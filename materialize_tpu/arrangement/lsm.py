@@ -23,9 +23,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.consolidate import consolidate
+from ..ops.consolidate import consolidate, merge_consolidate
 from ..ops.join import join_materialize, join_total
-from ..ops.reduce import AccumState, consolidate_accums, lookup_accums
+from ..ops.reduce import (
+    AccumState,
+    consolidate_accums,
+    lookup_accums,
+    merge_consolidate_accums,
+)
 from ..repr.batch import UpdateBatch
 
 
@@ -98,13 +103,10 @@ def lsm_insert(lsm: LsmBatches, delta: UpdateBatch, tick, ratio: int = 4, since=
         do_merge = (tick % period) == 0
 
         def merge(args, i=i):
+            # both levels are consolidate outputs (canonical order), so the
+            # merge is the O(n) searchsorted path — no re-sort
             lo, hi = args
-            cat = UpdateBatch.concat(hi, lo)
-            if since is not None:
-                from ..ops.consolidate import advance_times
-
-                cat = advance_times(cat, since)
-            merged = consolidate(cat)
+            merged = merge_consolidate(hi, lo, since=since)
             of = merged.count() > hi.cap
             return _empty_like(lo), merged.with_capacity(hi.cap), of
 
@@ -116,8 +118,8 @@ def lsm_insert(lsm: LsmBatches, delta: UpdateBatch, tick, ratio: int = 4, since=
         levels[i], levels[i + 1] = lo2, hi2
         overflow = overflow | of
 
-    # delta lands in level 0
-    l0 = consolidate(UpdateBatch.concat(levels[0], delta))
+    # delta lands in level 0 (delta is arranged = canonically sorted)
+    l0 = merge_consolidate(levels[0], delta)
     overflow = overflow | (l0.count() > levels[0].cap)
     levels[0] = l0.with_capacity(levels[0].cap)
     return LsmBatches(tuple(levels)), overflow
@@ -203,8 +205,8 @@ def accum_lsm_insert(lsm: LsmAccums, contrib: AccumState, tick, ratio: int = 4):
 
         def merge(args):
             lo, hi = args
-            merged = consolidate_accums(AccumState.concat(hi, lo))
-            of = merged.count() > hi.cap
+            merged, dup = merge_consolidate_accums(hi, lo)
+            of = (merged.count() > hi.cap) | dup
             return _empty_accum_like(lo), merged.with_capacity(hi.cap), of
 
         def keep(args):
@@ -214,7 +216,7 @@ def accum_lsm_insert(lsm: LsmAccums, contrib: AccumState, tick, ratio: int = 4):
         lo2, hi2, of = jax.lax.cond(do_merge, merge, keep, (levels[i], levels[i + 1]))
         levels[i], levels[i + 1] = lo2, hi2
         overflow = overflow | of
-    l0 = consolidate_accums(AccumState.concat(levels[0], contrib))
-    overflow = overflow | (l0.count() > levels[0].cap)
+    l0, dup = merge_consolidate_accums(levels[0], contrib)
+    overflow = overflow | (l0.count() > levels[0].cap) | dup
     levels[0] = l0.with_capacity(levels[0].cap)
     return LsmAccums(tuple(levels)), overflow

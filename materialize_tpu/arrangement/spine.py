@@ -19,7 +19,7 @@ from dataclasses import dataclass, field
 
 import jax.numpy as jnp
 
-from ..ops.consolidate import advance_times, consolidate
+from ..ops.consolidate import advance_times, consolidate, merge_consolidate
 from ..repr.batch import UpdateBatch, bucket_cap
 from ..repr.hashing import hash_columns
 
@@ -70,9 +70,9 @@ class Arrangement:
         ):
             b = self.batches.pop()
             a = self.batches.pop()
-            merged = consolidate(
-                advance_times(UpdateBatch.concat(a, b), self.since)
-            )
+            # spine batches are consolidate outputs (canonical order), so the
+            # O(n) searchsorted merge replaces the full re-sort
+            merged = merge_consolidate(a, b, since=jnp.uint64(self.since))
             self.batches.append(merged.with_capacity(bucket_cap(a.cap + b.cap)))
 
     def compact(self, since: int) -> None:

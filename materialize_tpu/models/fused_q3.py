@@ -33,7 +33,7 @@ from ..arrangement.lsm import (
 )
 from ..arrangement.spine import arrange_batch
 from ..expr import CallBinary, Column, Literal, MapFilterProject
-from ..ops.consolidate import consolidate
+from ..ops.consolidate import compact_to, consolidate, merge_consolidate
 from ..ops.reduce import AggregateExpr, _contributions, _emit_output, consolidate_accums
 from ..parallel.exchange import exchange
 from ..repr.batch import UpdateBatch, bucket_cap
@@ -198,6 +198,17 @@ def q3_tick(
     dl, f = _maybe_exchange(dl, axis_name, n_shards, caps.bucket)
     track(f)
 
+    # intermediate join streams: concat K per-level outputs, O(n)-compact the
+    # live rows into one small buffer, and only THEN sort — the r4 profile
+    # showed these full-static-capacity sorts were the bulk of tick time
+    mid_cap = bucket_cap(2 * caps.join_out)
+
+    def squeeze(batches: list) -> UpdateBatch:
+        nonlocal over
+        packed, f = compact_to(_concat_all(batches), mid_cap)
+        over = over | f
+        return packed
+
     outs = []
     if with_cust:
         fc, _ = _CUST_MFP.apply(d_cust)
@@ -207,7 +218,7 @@ def q3_tick(
         # path 0: d customer ⋈ orders(ck) ⋈ lineitem(ok)
         s0s, f = lsm_join(dc, state.ord_by_ck, jcaps)
         track(f)
-        s0 = arrange_batch(_concat_all(s0s), (1,), compact=False)  # key ok
+        s0 = arrange_batch(squeeze(s0s), (1,), compact=False)  # key ok
         s0, f = _maybe_exchange(s0, axis_name, n_shards, caps.bucket)
         track(f)
         s0s, f = lsm_join(s0, state.li_by_ok, jcaps)
@@ -221,7 +232,7 @@ def q3_tick(
     # path 1: d orders ⋈ customer(ck) ⋈ lineitem(ok)
     s1s, f = lsm_join(do_ck, new_cust, jcaps)
     track(f)
-    s1 = arrange_batch(_concat_all(s1s), (0,), compact=False)  # (ok,ck,od,sp | ck): key ok
+    s1 = arrange_batch(squeeze(s1s), (0,), compact=False)  # (ok,ck,od,sp | ck): key ok
     s1, f = _maybe_exchange(s1, axis_name, n_shards, caps.bucket)
     track(f)
     s1s, f = lsm_join(s1, state.li_by_ok, jcaps)
@@ -235,7 +246,7 @@ def q3_tick(
     # path 2: d lineitem ⋈ orders(ok) ⋈ customer(ck)
     s2s, f = lsm_join(dl, new_ord_ok, jcaps)
     track(f)
-    s2 = arrange_batch(_concat_all(s2s), (4,), compact=False)  # (lk,ep,dc | ok,ck,od,sp): key ck
+    s2 = arrange_batch(squeeze(s2s), (4,), compact=False)  # (lk,ep,dc | ok,ck,od,sp): key ck
     s2, f = _maybe_exchange(s2, axis_name, n_shards, caps.bucket)
     track(f)
     s2s, f = lsm_join(s2, new_cust, jcaps)
@@ -244,8 +255,8 @@ def q3_tick(
     new_li, f = lsm_insert(state.li_by_ok, dl, time, RATIO)
     track(f)
 
-    # closure + reduce
-    joined, errs1 = _CLOSURE.apply(_concat_all(outs))
+    # closure + reduce (closure is elementwise — run it on the compacted rows)
+    joined, errs1 = _CLOSURE.apply(squeeze(outs))
     grouped = arrange_batch(joined, (0, 1, 2), compact=False)
     grouped, f = _maybe_exchange(grouped, axis_name, n_shards, caps.bucket)
     track(f)
@@ -256,13 +267,20 @@ def q3_tick(
     from ..ops.reduce import collision_errs
 
     errs3 = collision_errs(contrib, missed, time)
-    out = consolidate(_emit_output(contrib, old_accums, old_nrows, time), compact=False)
+    emitted, f = compact_to(_emit_output(contrib, old_accums, old_nrows, time), mid_cap)
+    track(f)
+    out = consolidate(emitted, compact=False)
     new_accum, f = accum_lsm_insert(state.accum, contrib, time, RATIO)
     track(f)
 
-    errs = consolidate(
-        UpdateBatch.concat(UpdateBatch.concat(errs1, errs2), errs3), compact=False
+    # error streams are almost always empty: O(n)-compact the concat into a
+    # small buffer before the canonicalizing sort; an overflow of real error
+    # rows raises the tick's failure flag (loud, never silently dropped)
+    errs_cat, f = compact_to(
+        UpdateBatch.concat(UpdateBatch.concat(errs1, errs2), errs3), 8192
     )
+    track(f)
+    errs = consolidate(errs_cat, compact=False)
     new_state = Q3State(new_cust, new_ord_ck, new_ord_ok, new_li, new_accum)
     # overflow as shape-(1,) so shard_map can concatenate per-device flags
     return new_state, out, errs, over.reshape((1,))
@@ -278,7 +296,7 @@ def hydrate(state: Q3State, init_cust, init_ord, init_li, time) -> Q3State:
 
     def place(lsm: LsmBatches, keyed: UpdateBatch) -> LsmBatches:
         top = lsm.levels[-1]
-        merged = consolidate(UpdateBatch.concat(top, keyed))
+        merged = merge_consolidate(top, keyed)
         assert int(merged.count()) <= top.cap, "hydration exceeds top-level cap"
         return LsmBatches(tuple(lsm.levels[:-1]) + (merged.with_capacity(top.cap),))
 

@@ -2,10 +2,15 @@
 
 The TPU analogue of differential's `consolidate_updates` and of spine batch
 merging (reference hot loop list: SURVEY.md §3.2) — ONE fused XLA program:
-lexsort by (hash, keys…, vals…, time), segmented prefix-sum of diffs over
-equal-row runs, annihilated (diff==0) rows masked to padding and compacted to
-the front by a stable sort. O(n log n) on the MXU-adjacent sort units, no
-host round-trip.
+order by a packed u64 (key_hash<<32 | row_hash) with time as tiebreak,
+segmented prefix-sum of diffs over equal-row runs, annihilated (diff==0) rows
+masked to padding and compacted to the front. O(n log n) once per batch —
+and, critically, NOT per merge: two batches that are already in canonical
+order merge in O(n) via `merge_consolidate` (searchsorted interleave, no
+sort), and live rows compact in O(n) via a cumsum stable partition instead of
+an argsort. The r4 profile showed the per-tick consolidation sorts were ~70%
+of tick time; the merge/compact paths remove the sorts whose inputs are
+already ordered.
 """
 
 from __future__ import annotations
@@ -33,48 +38,84 @@ def row_equal_prev(cols) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((1,), dtype=jnp.bool_), eq])
 
 
-@partial(jax.jit, static_argnames=("compact",))
-def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
-    """Canonicalize a batch: hash-sorted, equal rows merged, no zero diffs.
+def pack_sort_key(batch: UpdateBatch) -> jnp.ndarray:
+    """The canonical u64 ordering key: (key_hash << 32) | row_hash.
 
-    The sort key is (key_hash, row_hash, time-view) — 3 fixed u32 operands
-    instead of the full row (TPU sorts cost per 32-bit operand in both
-    runtime and compile time; this is the single hottest kernel). row_hash is
-    a u32 content hash of the val columns, so duplicate rows inside one key
-    group still land adjacent and annihilate; equal-row runs are then
-    confirmed by full-row adjacent comparison, which keeps correctness under
-    hash collisions — colliding distinct rows merely stay split across
-    entries, and every consumer treats a batch as a multiset of
-    (row, time, diff) updates (operators are linear in diff), so only perfect
-    annihilation (a capacity concern, not correctness) needs adjacency.
-    The time operand is the LOW 32 bits of the u64 time: distinct times
-    2^32 apart may interleave within a row's run, splitting it — again a
-    capacity concern only, and impossible for tick-counter times.
-
-    Padding rows sort last (PAD_HASH) and keep diff 0, so they fold into one
-    run that is masked back out. Output has the same capacity.
-
-    With ``compact=False`` the second (compaction) sort is skipped:
-    annihilated rows keep their hash/time in place with diff forced to 0, so
-    the output is STILL hash-sorted and probe-able but dead rows occupy
-    interior slots. Use for probe streams and operator outputs — anything not
-    about to be capacity-shrunk (`with_capacity` truncation needs live rows
-    in front, so arrangement level contents keep compact=True). Dead rows
-    are inert everywhere (consumers test diff != 0) but DO widen join
-    candidate ranges, so arrangements should stay compacted.
+    row_hash is a u32 content hash of the val columns, so duplicate rows
+    inside one key group land adjacent and annihilate. PAD_HASH rows pack to
+    >= 0xFFFFFFFF_00000000, above every live key (hash_columns clamps live
+    hashes below PAD_HASH), so padding sorts last. A batch sorted by this key
+    is sorted by key hash — exactly what binary-search probes need.
     """
     from ..repr.hashing import hash_columns
 
-    cap = batch.cap
     if batch.vals:
         row_hash = hash_columns(batch.vals)
     else:
         row_hash = jnp.zeros_like(batch.hashes)
-    order = jnp.lexsort(
-        (batch.times.astype(jnp.uint32), row_hash, batch.hashes)
+    return (batch.hashes.astype(jnp.uint64) << jnp.uint64(32)) | row_hash.astype(
+        jnp.uint64
     )
-    b = batch.permute(order)
 
+
+def _stable_partition_perm(live: jnp.ndarray) -> jnp.ndarray:
+    """Permutation moving live rows to the front, stably, in O(n).
+
+    Equivalent to argsort(~live, stable=True) without the sort: target slots
+    come from two cumsums, and the gather permutation is their scatter
+    inverse. (Init arrays derive from the data so varying manual axes match
+    under shard_map.)
+    """
+    li = live.astype(jnp.int32)
+    front = jnp.cumsum(li) - 1
+    total = front[-1] + 1
+    back = total + jnp.cumsum(1 - li) - 1
+    pos = jnp.where(live, front, back)
+    iota = jnp.arange(pos.shape[0], dtype=pos.dtype)
+    return (pos * 0).at[pos].set(iota)
+
+
+def _filled_like(col: jnp.ndarray, cap: int, fill) -> jnp.ndarray:
+    """A (cap,)-shaped fill array whose varying axes derive from `col`."""
+    seed = jnp.where(jnp.zeros((1,), jnp.bool_), col[:1], jnp.asarray(fill, col.dtype))
+    return jnp.broadcast_to(seed, (cap,))
+
+
+@partial(jax.jit, static_argnames=("cap",))
+def compact_to(batch: UpdateBatch, cap: int):
+    """O(n) compaction of live rows into a fresh batch of capacity `cap`.
+
+    Returns (batch', overflow). Order among live rows is preserved (a sorted
+    input stays sorted); rows beyond `cap` are dropped with the overflow flag
+    raised — callers must treat an overflowing compaction as a failed tick,
+    exactly like an arrangement-capacity overflow. This is what lets fused
+    ticks concatenate K wide operator outputs and then sort only the small
+    live prefix instead of the full static capacity.
+    """
+    live = batch.live
+    pos = jnp.cumsum(live.astype(jnp.int32)) - 1
+    total = pos[-1] + 1
+    over = total > cap
+    idx = jnp.where(live, pos, cap)  # dead (and overflowing) rows drop
+
+    def scat(col, fill):
+        return _filled_like(col, cap, fill).at[idx].set(col, mode="drop")
+
+    out = UpdateBatch(
+        scat(batch.hashes, PAD_HASH),
+        tuple(scat(k, 0) for k in batch.keys),
+        tuple(scat(v, 0) for v in batch.vals),
+        scat(batch.times, PAD_TIME),
+        scat(batch.diffs, 0),
+    )
+    return out, over
+
+
+def _consolidate_sorted(b: UpdateBatch, compact: bool) -> UpdateBatch:
+    """Run-merge + mask tail shared by `consolidate` and `merge_consolidate`.
+
+    Requires `b` ordered so equal (key, row, time) rows are adjacent."""
+    cap = b.cap
     cmp_cols = [b.hashes, *b.keys, *b.vals, b.times]
     same = row_equal_prev(cmp_cols)
     run_start = ~same
@@ -92,9 +133,77 @@ def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
     vals = tuple(jnp.where(live, v, jnp.zeros_like(v)) for v in b.vals)
     times = jnp.where(live, b.times, PAD_TIME)
 
-    # Compact live rows to the front, preserving canonical order.
-    perm = jnp.argsort(~live, stable=True)
+    perm = _stable_partition_perm(live)
     return UpdateBatch(hashes, keys, vals, times, diffs).permute(perm)
+
+
+@partial(jax.jit, static_argnames=("compact",))
+def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
+    """Canonicalize a batch: hash-sorted, equal rows merged, no zero diffs.
+
+    The sort key is (packed u64 key, time-view) — 2 fixed operands instead of
+    the full row (TPU sorts cost per 32-bit operand in both runtime and
+    compile time; this is the single hottest kernel). See `pack_sort_key`:
+    duplicate rows inside one key group land adjacent and annihilate;
+    equal-row runs are then confirmed by full-row adjacent comparison, which
+    keeps correctness under hash collisions — colliding distinct rows merely
+    stay split across entries, and every consumer treats a batch as a
+    multiset of (row, time, diff) updates (operators are linear in diff), so
+    only perfect annihilation (a capacity concern, not correctness) needs
+    adjacency. The time operand is the LOW 32 bits of the u64 time: distinct
+    times 2^32 apart may interleave within a row's run, splitting it — again
+    a capacity concern only, and impossible for tick-counter times.
+
+    Padding rows sort last (PAD_HASH) and keep diff 0, so they fold into one
+    run that is masked back out. Output has the same capacity.
+
+    With ``compact=False`` the compaction pass is skipped: annihilated rows
+    keep their hash/time in place with diff forced to 0, so the output is
+    STILL hash-sorted and probe-able but dead rows occupy interior slots. Use
+    for probe streams and operator outputs — anything not about to be
+    capacity-shrunk (`with_capacity` truncation needs live rows in front, so
+    arrangement level contents keep compact=True). Dead rows are inert
+    everywhere (consumers test diff != 0) but DO widen join candidate ranges,
+    so arrangements should stay compacted.
+    """
+    packed = pack_sort_key(batch)
+    order = jnp.lexsort((batch.times.astype(jnp.uint32), packed))
+    return _consolidate_sorted(batch.permute(order), compact)
+
+
+@jax.jit
+def merge_consolidate(
+    a: UpdateBatch, b: UpdateBatch, since: jnp.ndarray | None = None
+) -> UpdateBatch:
+    """Merge two batches that are ALREADY in canonical order, in O(n).
+
+    The LSM merge fast path: both inputs are `consolidate` outputs (every
+    spine level and every arranged delta is), so instead of re-sorting the
+    concatenation the merged order comes from two searchsorted passes over
+    the packed keys — the differential spine's cursor merge
+    (src/compute/src/render/join/mz_join_core.rs-adjacent batch merger),
+    vectorized. Output capacity = a.cap + b.cap, live rows compacted to the
+    front (callers truncate with with_capacity after checking counts).
+
+    With `since`, times first advance to the compaction frontier so +/- pairs
+    at bygone times cancel. Annihilation nuance: within one packed-key
+    cluster the merged order is a's rows then b's; when a and b hold equal
+    rows at *different interleaved* times the pairs may not touch — they
+    still cancel once `since` passes both (times then collapse equal), so
+    this costs capacity transiently, never correctness (multiset semantics).
+    """
+    ka = pack_sort_key(a)
+    kb = pack_sort_key(b)
+    na, nb = a.cap, b.cap
+    pa = jnp.arange(na) + jnp.searchsorted(kb, ka, side="left")
+    pb = jnp.arange(nb) + jnp.searchsorted(ka, kb, side="right")
+    pos = jnp.concatenate([pa, pb]).astype(jnp.int32)
+    iota = jnp.arange(na + nb, dtype=jnp.int32)
+    perm = (pos * 0).at[pos].set(iota)
+    cat = UpdateBatch.concat(a, b).permute(perm)
+    if since is not None:
+        cat = advance_times(cat, since)
+    return _consolidate_sorted(cat, compact=True)
 
 
 def _cmp_view(c: jnp.ndarray) -> jnp.ndarray:

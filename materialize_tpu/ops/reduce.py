@@ -140,19 +140,36 @@ def agg_out_dtype(a: AggregateExpr) -> np.dtype:
     return np.dtype(np.float32) if a.fixed_scale else np.dtype(a.accum_dtype)
 
 
-@jax.jit
-def consolidate_accums(s: AccumState) -> AccumState:
-    """Sort by (hash, keys), sum accumulators of equal keys, drop empty groups."""
+def _accum_pack(s: AccumState) -> jnp.ndarray:
+    """Canonical u64 ordering key of an accum table: (key_hash<<32) | mix.
+
+    Sorting by this (with the raw keys as tiebreak in the sort path) makes
+    two independently consolidated tables mergeable by a single searchsorted
+    pass: rows from different tables that agree on the packed key but hold
+    different keys need a 2^-64 double-collision, which
+    merge_consolidate_accums detects and flags rather than mis-merging.
+    PAD rows pack above every live key (hash_columns clamps below PAD_HASH).
+    """
+    from ..repr.hashing import mix_columns
+
+    if s.keys:
+        mix = mix_columns(s.keys)
+    else:
+        mix = jnp.zeros_like(s.hashes)
+    return (s.hashes.astype(jnp.uint64) << jnp.uint64(32)) | mix.astype(jnp.uint64)
+
+
+def _consolidate_accums_sorted(s: AccumState):
+    """Run-merge + compaction tail over a packed-key-ordered table.
+
+    Run boundaries come from full (hash, keys) row comparison — the packed
+    ordering only guarantees equal keys land adjacent (sort path) or within
+    a tiny cluster (merge path). Returns (state', dup): `dup` flags live
+    same-key rows that survived unmerged (possible only via a packed-key
+    double collision between sources in the merge path) — callers surface
+    it as a failed tick."""
     cap = s.cap
-    cols = [*(k for k in reversed(s.keys)), s.hashes]
-    order = jnp.lexsort(cols)
-    s = AccumState(
-        s.hashes[order],
-        tuple(k[order] for k in s.keys),
-        tuple(a[order] for a in s.accums),
-        s.nrows[order],
-    )
-    from .consolidate import row_equal_prev
+    from .consolidate import _stable_partition_perm, row_equal_prev
 
     run_start = ~row_equal_prev((s.hashes, *s.keys))
     seg = jnp.cumsum(run_start.astype(jnp.int32)) - 1
@@ -169,13 +186,69 @@ def consolidate_accums(s: AccumState) -> AccumState:
     keys = tuple(jnp.where(live, k, jnp.zeros_like(k)) for k in s.keys)
     accums = tuple(jnp.where(live, a, jnp.zeros_like(a)) for a in accums)
     nrows = jnp.where(live, nrows, 0)
-    perm = jnp.argsort(~live, stable=True)
-    return AccumState(
+    perm = _stable_partition_perm(live)
+    out = AccumState(
         hashes[perm],
         tuple(k[perm] for k in keys),
         tuple(a[perm] for a in accums),
         nrows[perm],
     )
+    # unmerged duplicates sit within a few slots of each other post-compaction
+    # (a double-collision cluster holds 2 distinct keys from each source)
+    from ..repr.hashing import value_view
+
+    dup = out.count() < 0  # varying-typed False
+    for d in (1, 2, 3):
+        eq = (out.hashes[d:] == out.hashes[:-d]) & (out.hashes[d:] != PAD_HASH)
+        for k in out.keys:
+            kv = value_view(k)
+            eq = eq & (kv[d:] == kv[:-d])
+        dup = dup | jnp.any(eq)
+    return out, dup
+
+
+@jax.jit
+def consolidate_accums(s: AccumState) -> AccumState:
+    """Order by (packed key, keys), sum accumulators of equal keys, drop
+    empty groups. Keys tiebreak the sort, so equal keys are always adjacent
+    here (no collision exposure on this path)."""
+    packed = _accum_pack(s)
+    order = jnp.lexsort((*(k for k in reversed(s.keys)), packed))
+    s = AccumState(
+        s.hashes[order],
+        tuple(k[order] for k in s.keys),
+        tuple(a[order] for a in s.accums),
+        s.nrows[order],
+    )
+    out, _dup = _consolidate_accums_sorted(s)
+    return out
+
+
+@jax.jit
+def merge_consolidate_accums(a: AccumState, b: AccumState):
+    """O(n) merge of two consolidated accum tables by packed key.
+
+    Returns (state', dup). Both inputs must be consolidate_accums /
+    merge_consolidate_accums outputs (packed-key order, unique live keys).
+    `dup` is the loud-failure flag for the 2^-64 packed-key double collision
+    (see _accum_pack) — treated like a capacity overflow by callers, never a
+    silent mis-aggregation."""
+    ka = _accum_pack(a)
+    kb = _accum_pack(b)
+    na, nb = a.cap, b.cap
+    pa = jnp.arange(na) + jnp.searchsorted(kb, ka, side="left")
+    pb = jnp.arange(nb) + jnp.searchsorted(ka, kb, side="right")
+    pos = jnp.concatenate([pa, pb]).astype(jnp.int32)
+    iota = jnp.arange(na + nb, dtype=jnp.int32)
+    perm = (pos * 0).at[pos].set(iota)
+    cat = AccumState.concat(a, b)
+    s = AccumState(
+        cat.hashes[perm],
+        tuple(k[perm] for k in cat.keys),
+        tuple(x[perm] for x in cat.accums),
+        cat.nrows[perm],
+    )
+    return _consolidate_accums_sorted(s)
 
 
 @partial(jax.jit, static_argnames=("key_cols", "aggs"))

@@ -84,6 +84,24 @@ def hash_columns(cols: tuple[jnp.ndarray, ...]) -> jnp.ndarray:
     return jnp.where(h32 == PAD_HASH, PAD_HASH - np.uint32(1), h32)
 
 
+def mix_columns(cols: tuple[jnp.ndarray, ...]) -> jnp.ndarray:
+    """A second, independent u32 hash of the same columns.
+
+    Paired with `hash_columns` to form a 64-bit ordering key for accumulator
+    tables (reduce.py): rows agreeing on BOTH hashes but differing in keys
+    need a ~2^-64 coincidence, which the merge kernels detect and surface
+    loudly rather than mis-merge. Different init constant and salt stream
+    than hash_columns, same splitmix64 mixing.
+    """
+    if not cols:
+        return jnp.zeros((), dtype=jnp.uint32)
+    h = jnp.full(cols[0].shape, np.uint64(0xA076_1D64_78BD_642F), dtype=jnp.uint64)
+    for i, col in enumerate(cols):
+        salt = np.uint64(((i + 7) * int(_C3)) % (1 << 64))
+        h = splitmix64(h ^ splitmix64(_col_to_u64(col) ^ salt))
+    return (h ^ (h >> np.uint64(32))).astype(jnp.uint32)
+
+
 def hash_columns_np(cols) -> np.ndarray:
     """NumPy mirror of `hash_columns` (host-side oracle + batch construction)."""
     import jax
