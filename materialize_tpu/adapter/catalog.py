@@ -73,6 +73,10 @@ class Catalog:
     def __init__(self) -> None:
         self.items: dict[str, CatalogItem] = {}
         self.dict = StringDictionary()
+        from ..expr.strings import StringFuncTables
+
+        # engine-wide string-function code tables, tied to this dictionary
+        self.str_tables = StringFuncTables(self.dict)
         self._next_id = 0
 
     def allocate_id(self, prefix: str = "u") -> str:

@@ -1626,6 +1626,17 @@ def _eval_scalar_on_row(e, row: list):
             # f32 like the device kernel (expr/scalar.py sqrt), so host
             # fast-path peeks agree bit-for-bit with rendered dataflows
             return float(np.sqrt(np.float32(v), dtype=np.float32))
+        if e.func in s._DATE_UNARY:
+            from ..expr.scalar import date_unary_int
+
+            return date_unary_int(e.func, int(v))
+        if e.func in s._FLOAT_UNARY_NP:
+            return float(np.float32(s._FLOAT_UNARY_NP[e.func](np.float32(v))))
+        if e.func == "round_half_away":
+            fv = np.float32(v)
+            return float(np.float32(np.sign(fv) * np.floor(np.abs(fv) + np.float32(0.5))))
+        if e.func == "sign":
+            return float(np.sign(v)) if isinstance(v, float) else int(np.sign(v))
         return {
             "neg": lambda: -v,
             "not": lambda: not v,
@@ -1667,11 +1678,17 @@ def _eval_scalar_on_row(e, row: list):
                 return f32(np.float32(l) / np.float32(r))
             q = abs(l) // abs(r)
             return -q if (l < 0) != (r < 0) else q
+        if e.func in ("fdiv", "fmod"):
+            if r == 0:
+                raise PlanError("division by zero")
+            return l // r if e.func == "fdiv" else l - r * (l // r)
         return {
             "add": lambda: f32(np.float32(l) + np.float32(r)) if fl else l + r,
             "sub": lambda: f32(np.float32(l) - np.float32(r)) if fl else l - r,
             "mul": lambda: f32(np.float32(l) * np.float32(r)) if fl else l * r,
             "mod": lambda: l - r * (abs(l) // abs(r)) * (1 if (l < 0) == (r < 0) else -1),
+            "pow": lambda: f32(np.power(np.float32(l), np.float32(r))),
+            "atan2": lambda: f32(np.arctan2(np.float32(l), np.float32(r))),
             "eq": lambda: l == r,
             "ne": lambda: l != r,
             "lt": lambda: l < r,
@@ -1713,6 +1730,17 @@ def _eval_scalar_on_row(e, row: list):
         if e.func == "least":
             nn = [v for v in vs if v is not None]
             return min(nn) if nn else None
+    if isinstance(e, s.DictFunc):
+        vs = [_eval_scalar_on_row(a, row) for a in e.args]
+        if any(v is None for v in vs):
+            return None
+        args = [e.tables._decode_arg(at, v) for at, v in zip(e.argtypes, vs)]
+        r = e.tables.eval_one(e.spec, args)
+        if e.out == "string":
+            return e.tables.dct.encode(r)
+        if e.out == "bool":
+            return bool(r)
+        return int(r)
     raise PlanError(f"cannot evaluate {e!r} host-side")
 
 

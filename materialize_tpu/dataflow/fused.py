@@ -155,6 +155,20 @@ class FusedCompiler:
     def _check_supported(self, e) -> None:
         if isinstance(e, (lir.LetRec, lir.TemporalFilter, lir.FlatMap)):
             raise FusedUnsupported(type(e).__name__)
+        from ..expr.scalar import expr_has_dictfunc
+
+        def no_dictfunc(exprs):
+            # string-function tables are host state; they cannot bake into a
+            # compiled tick (stale as the dictionary grows) — host path only
+            if any(expr_has_dictfunc(x) for x in exprs):
+                raise FusedUnsupported("DictFunc")
+
+        if isinstance(e, lir.Mfp):
+            no_dictfunc(list(e.mfp.map_exprs) + list(e.mfp.predicates))
+        if isinstance(e, lir.Join) and e.closure is not None:
+            no_dictfunc(list(e.closure.map_exprs) + list(e.closure.predicates))
+        if isinstance(e, lir.Reduce) and not e.distinct:
+            no_dictfunc([a.expr for a in e.aggs])
         for child in _children(e):
             self._check_supported(child)
 

@@ -831,11 +831,20 @@ class Dataflow:
                 ops.append((DeltaJoinNode(e.plan, e.closure, len(refs)), refs))
             return len(ops) - 1
         if isinstance(e, lir.Reduce):
+            from ..expr.scalar import expr_has_dictfunc
+
             in_dt = self._infer_dtypes(e.input)
             if (
                 not e.distinct
                 and isinstance(e.input, lir.Mfp)
                 and all(a.func in ("sum", "count") for a in e.aggs)
+                # string-function MFPs need host tables: keep the MFP as its
+                # own eagerly-evaluated node instead of tracing it into the
+                # fused reduce tick
+                and not any(
+                    expr_has_dictfunc(x)
+                    for x in list(e.input.mfp.map_exprs) + list(e.input.mfp.predicates)
+                )
             ):
                 # fuse the feeding MFP into the reduce tick (one dispatch)
                 ref = self._render(e.input.input, ops)
@@ -997,12 +1006,18 @@ def _expr_dtype(expr, col_dtypes):
         return np.dtype(col_dtypes[expr.index])
     if isinstance(expr, s.Literal):
         return np.dtype(expr.dtype)
+    if isinstance(expr, s.DictFunc):
+        return np.dtype(np.int8) if expr.out == "bool" else np.dtype(np.int64)
     if isinstance(expr, s.CallUnary):
         if expr.func in ("cast_int64", "extract_year", "extract_month", "extract_day"):
             return np.dtype(np.int64)
+        if expr.func in s._DATE_UNARY:
+            return np.dtype(np.int64)
         if expr.func in ("cast_int32",):
             return np.dtype(np.int32)
-        if expr.func in ("cast_float", "sqrt"):
+        if expr.func in ("cast_float", "sqrt", "round_half_away"):
+            return np.dtype(np.float32)
+        if expr.func in s._FLOAT_UNARY:
             return np.dtype(np.float32)
         if expr.func == "is_true":
             return np.dtype(np.bool_)

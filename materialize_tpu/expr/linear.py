@@ -26,6 +26,7 @@ from .scalar import (
     CallUnary,
     CallVariadic,
     Column,
+    DictFunc,
     Literal,
     ScalarExpr,
     eval_expr,
@@ -50,6 +51,14 @@ def substitute_columns(e: ScalarExpr, mapping) -> ScalarExpr:
     if isinstance(e, CallVariadic):
         return CallVariadic(
             e.func, tuple(substitute_columns(x, mapping) for x in e.exprs)
+        )
+    if isinstance(e, DictFunc):
+        return DictFunc(
+            e.spec,
+            tuple(substitute_columns(x, mapping) for x in e.args),
+            e.argtypes,
+            e.out,
+            e.tables,
         )
     raise TypeError(f"not a ScalarExpr: {e!r}")
 

@@ -107,6 +107,10 @@ class EvalErr(enum.IntEnum):
     # distinct live keys sharing one 32-bit hash — rare but plausible at
     # tens of millions of keys; detected, never silent)
     HASH_COLLISION_EXHAUSTED = 3
+    # a string column held a code outside the dictionary (corrupt data);
+    # string-function tables cannot resolve it
+    STRING_CODE_OOB = 4
+    NEGATIVE_FUNC_ARG = 5
 
 
 @dataclass(frozen=True)
@@ -141,7 +145,30 @@ class CallVariadic:
     exprs: tuple
 
 
-ScalarExpr = Any  # Column | Literal | CallUnary | CallBinary | CallVariadic
+@dataclass(frozen=True, eq=False)
+class DictFunc:
+    """A string function over dictionary codes (expr/strings.py).
+
+    `spec` = (name, *literal_args); `args` are ScalarExprs; `argtypes` tags
+    how each arg decodes for multi-arg host evaluation ("str" args are codes).
+    `out` is the result kind: "string" (i64 code), "int64", or "bool" (i8).
+    `tables` is the engine's StringFuncTables registry — a mutable reference
+    shared with the catalog's dictionary, deliberately outside eq/hash.
+
+    Single-string-arg specs evaluate on device as one table gather; multi-arg
+    specs decode host-side (eager host path only). The fused renderer rejects
+    plans containing DictFunc (tables would bake stale into the compiled
+    program) and falls back to the host-orchestrated path.
+    """
+
+    spec: tuple
+    args: tuple
+    argtypes: tuple
+    out: str
+    tables: Any
+
+
+ScalarExpr = Any  # Column | Literal | CallUnary | CallBinary | CallVariadic | DictFunc
 
 
 def eval_expr(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
@@ -212,9 +239,18 @@ def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             return v.astype(jnp.float32), null, e
         if f == "sqrt":
             return jnp.sqrt(v.astype(jnp.float32)), null, e
+        if f in _FLOAT_UNARY:
+            return _FLOAT_UNARY[f](v.astype(jnp.float32)), null, e
+        if f == "round_half_away":
+            fv = v.astype(jnp.float32)
+            return jnp.sign(fv) * jnp.floor(jnp.abs(fv) + jnp.float32(0.5)), null, e
+        if f == "sign":
+            return jnp.sign(v), null, e
         if f in ("extract_year", "extract_month", "extract_day"):
             y, m, d = _civil_from_days(v)
             return {"extract_year": y, "extract_month": m, "extract_day": d}[f], null, e
+        if f in _DATE_UNARY:
+            return _DATE_UNARY[f](v), null, e
         raise NotImplementedError(f"unary func {f}")
     if isinstance(expr, CallBinary):
         f = expr.func
@@ -273,6 +309,19 @@ def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             return jnp.minimum(lv, rv), null, err
         if f == "max":
             return jnp.maximum(lv, rv), null, err
+        if f == "pow":
+            return jnp.power(lv.astype(jnp.float32), rv.astype(jnp.float32)), null, err
+        if f == "atan2":
+            return jnp.arctan2(lv.astype(jnp.float32), rv.astype(jnp.float32)), null, err
+        if f in ("fdiv", "fmod"):
+            # FLOOR division/modulo (internal: date_trunc/extract arithmetic;
+            # SQL-visible div/mod truncate toward zero instead)
+            zero = (rv == 0) & ~null
+            safe = jnp.where(rv == 0, jnp.ones_like(rv), rv)
+            err = jnp.where(zero, jnp.int32(EvalErr.DIVISION_BY_ZERO), err)
+            if f == "fdiv":
+                return lv // safe, null, err
+            return lv - safe * (lv // safe), null, err
         raise NotImplementedError(f"binary func {f}")
     if isinstance(expr, CallVariadic):
         f = expr.func
@@ -330,11 +379,190 @@ def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
                 null = null & m
             return out, null, err
         raise NotImplementedError(f"variadic func {f}")
+    if isinstance(expr, DictFunc):
+        parts = [eval_expr3(a, cols, n) for a in expr.args]
+        vals = [p[0] for p in parts]
+        null = parts[0][1]
+        err = parts[0][2]
+        for _, nv, ev in parts[1:]:
+            null = null | nv
+            err = jnp.maximum(err, ev)
+        err = jnp.where(null, 0, err)
+        import jax.core as _core
+
+        if any(isinstance(v, _core.Tracer) for v in vals) or isinstance(
+            null, _core.Tracer
+        ):
+            # tables are host state; baking them into a compiled program
+            # would go stale as the dictionary grows (fused path rejects
+            # DictFunc upfront — this guard catches any other jit use)
+            raise NotImplementedError("string functions evaluate host-side only")
+        if len(vals) == 1:
+            tbl = jnp.asarray(expr.tables.table(expr.spec))
+            m = int(tbl.shape[0])
+            code = vals[0].astype(jnp.int64)
+            oob = (~null) & ((code < 0) | (code >= m))
+            if m:
+                out = tbl[jnp.clip(code, 0, m - 1)]
+            else:
+                out = jnp.zeros((n,), dtype=tbl.dtype)
+            err = jnp.where(oob, jnp.int32(EvalErr.STRING_CODE_OOB), err)
+        else:
+            res, oob = expr.tables.eval_multi(
+                expr.spec,
+                expr.argtypes,
+                [np.asarray(v) for v in vals],
+                np.asarray(null),
+            )
+            out = jnp.asarray(res)
+            err = jnp.where(
+                jnp.asarray(oob), jnp.int32(EvalErr.STRING_CODE_OOB), err
+            )
+        if expr.out == "bool":
+            out = out.astype(jnp.int8)
+        return out, null, err
     raise TypeError(f"not a ScalarExpr: {expr!r}")
 
 
 # days between 1970-01-01 and the engine's date epoch 1992-01-01
 _D1992 = 8035
+
+# float32 elementwise math (device VPU transcendentals; host mirror uses the
+# same f32 width so fast-path peeks agree bit-for-bit)
+_FLOAT_UNARY = {
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "trunc": jnp.trunc,
+    "exp": jnp.exp,
+    "ln": jnp.log,
+    "log10": lambda v: jnp.log10(v),
+    "log2": lambda v: jnp.log2(v),
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "cot": lambda v: jnp.float32(1.0) / jnp.tan(v),
+    "cbrt": jnp.cbrt,
+    "degrees": jnp.degrees,
+    "radians": jnp.radians,
+}
+
+# host numpy mirror of _FLOAT_UNARY (same names, same f32 width) — kept
+# adjacent so the two tables cannot silently diverge; the fast-path row
+# interpreter uses this to agree bit-for-bit with device kernels
+_FLOAT_UNARY_NP = {
+    "floor": np.floor,
+    "ceil": np.ceil,
+    "trunc": np.trunc,
+    "exp": np.exp,
+    "ln": np.log,
+    "log10": np.log10,
+    "log2": np.log2,
+    "sin": np.sin,
+    "cos": np.cos,
+    "tan": np.tan,
+    "asin": np.arcsin,
+    "acos": np.arccos,
+    "atan": np.arctan,
+    "sinh": np.sinh,
+    "cosh": np.cosh,
+    "tanh": np.tanh,
+    "cot": lambda v: np.float32(1.0) / np.tan(v),
+    "cbrt": np.cbrt,
+    "degrees": np.degrees,
+    "radians": np.radians,
+}
+assert set(_FLOAT_UNARY_NP) == set(_FLOAT_UNARY)
+
+
+def _days_from_civil(y, m, d):
+    """Inverse of _civil_from_days: (y, m, d) → day number since 1992-01-01."""
+    y = y - (m <= 2)
+    era = y // 400  # jnp // floors, as the algorithm requires for y < 0
+    yoe = y - era * 400
+    doy = (153 * (m + jnp.where(m > 2, -3, 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468 - _D1992
+
+
+def _date_dow(v):
+    """Day of week, Sunday = 0 (pg extract(dow)). 1970-01-01 was Thursday."""
+    return jnp.remainder(v.astype(jnp.int64) + _D1992 + 4, 7)
+
+
+def _date_isodow(v):
+    """ISO day of week, Monday = 1 … Sunday = 7."""
+    return jnp.remainder(v.astype(jnp.int64) + _D1992 + 3, 7) + 1
+
+
+def _date_doy(v):
+    y, _m, _d = _civil_from_days(v)
+    ones = jnp.ones_like(y)
+    return v.astype(jnp.int64) - _days_from_civil(y, ones, ones) + 1
+
+
+def _iso_long_year(y):
+    """53-week ISO years: Jan 1 is Thursday, or leap year with Jan 1 Wednesday."""
+    ones = jnp.ones_like(y)
+    jan1 = _days_from_civil(y, ones, ones)
+    dow = _date_isodow(jan1)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    return (dow == 4) | (leap & (dow == 3))
+
+
+def _date_isoweek(v):
+    y, _m, _d = _civil_from_days(v)
+    w = (_date_doy(v) - _date_isodow(v) + 10) // 7
+    weeks_prev = jnp.where(_iso_long_year(y - 1), 53, 52)
+    weeks_cur = jnp.where(_iso_long_year(y), 53, 52)
+    # the two rollovers are exclusive: w<1 borrows the previous year's last
+    # week; only an ORIGINAL w past this year's count wraps to week 1
+    return jnp.where(w < 1, weeks_prev, jnp.where(w > weeks_cur, 1, w))
+
+
+def _trunc_year(v):
+    y, _m, _d = _civil_from_days(v)
+    ones = jnp.ones_like(y)
+    return _days_from_civil(y, ones, ones)
+
+
+def _trunc_quarter(v):
+    y, m, _d = _civil_from_days(v)
+    qm = ((m - 1) // 3) * 3 + 1
+    return _days_from_civil(y, qm, jnp.ones_like(y))
+
+
+def _trunc_month(v):
+    y, m, _d = _civil_from_days(v)
+    return _days_from_civil(y, m, jnp.ones_like(y))
+
+
+def _trunc_week(v):
+    """Monday of v's ISO week."""
+    return v.astype(jnp.int64) - (_date_isodow(v) - 1)
+
+
+_DATE_UNARY = {
+    "extract_dow": _date_dow,
+    "extract_isodow": _date_isodow,
+    "extract_doy": _date_doy,
+    "extract_quarter": lambda v: (_civil_from_days(v)[1] + 2) // 3,
+    "extract_week": _date_isoweek,
+    "extract_epoch_date": lambda v: (v.astype(jnp.int64) + _D1992) * 86400,
+    "extract_century": lambda v: (_civil_from_days(v)[0] + 99) // 100,
+    "extract_decade": lambda v: _civil_from_days(v)[0] // 10,
+    "extract_millennium": lambda v: (_civil_from_days(v)[0] + 999) // 1000,
+    "date_trunc_year": _trunc_year,
+    "date_trunc_quarter": _trunc_quarter,
+    "date_trunc_month": _trunc_month,
+    "date_trunc_week": _trunc_week,
+    "date_trunc_day": lambda v: v,
+}
 
 
 def civil_from_days_int(days: int) -> tuple:
@@ -350,6 +578,67 @@ def civil_from_days_int(days: int) -> tuple:
     d = doy - (153 * mp + 2) // 5 + 1
     m = mp + (3 if mp < 10 else -9)
     return y + (1 if m <= 2 else 0), m, d
+
+
+def days_from_civil_int(y: int, m: int, d: int) -> int:
+    """Pure-int inverse of civil_from_days_int (host mirror of _days_from_civil)."""
+    y = y - (1 if m <= 2 else 0)
+    era = y // 400
+    yoe = y - era * 400
+    doy = (153 * (m + (-3 if m > 2 else 9)) + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468 - _D1992
+
+
+def date_unary_int(f: str, v: int) -> int:
+    """Host mirror of _DATE_UNARY for the fast-path row interpreter —
+    bit-identical to the device kernels (both are pure integer Hinnant
+    calendar arithmetic)."""
+    v = int(v)
+    if f == "extract_dow":
+        return (v + _D1992 + 4) % 7
+    if f == "extract_isodow":
+        return (v + _D1992 + 3) % 7 + 1
+    y, m, d = civil_from_days_int(v)
+    if f == "extract_doy":
+        return v - days_from_civil_int(y, 1, 1) + 1
+    if f == "extract_quarter":
+        return (m + 2) // 3
+    if f == "extract_week":
+        doy = v - days_from_civil_int(y, 1, 1) + 1
+        isodow = (v + _D1992 + 3) % 7 + 1
+        w = (doy - isodow + 10) // 7
+
+        def long_year(yy):
+            jan1 = days_from_civil_int(yy, 1, 1)
+            dw = (jan1 + _D1992 + 3) % 7 + 1
+            leap = (yy % 4 == 0 and yy % 100 != 0) or yy % 400 == 0
+            return dw == 4 or (leap and dw == 3)
+
+        if w < 1:
+            return 53 if long_year(y - 1) else 52
+        if w > (53 if long_year(y) else 52):
+            return 1
+        return w
+    if f == "extract_epoch_date":
+        return (v + _D1992) * 86400
+    if f == "extract_century":
+        return (y + 99) // 100
+    if f == "extract_decade":
+        return y // 10
+    if f == "extract_millennium":
+        return (y + 999) // 1000
+    if f == "date_trunc_year":
+        return days_from_civil_int(y, 1, 1)
+    if f == "date_trunc_quarter":
+        return days_from_civil_int(y, ((m - 1) // 3) * 3 + 1, 1)
+    if f == "date_trunc_month":
+        return days_from_civil_int(y, m, 1)
+    if f == "date_trunc_week":
+        return v - ((v + _D1992 + 3) % 7)
+    if f == "date_trunc_day":
+        return v
+    raise NotImplementedError(f"date func {f}")
 
 
 def _civil_from_days(days):
@@ -383,4 +672,22 @@ def expr_columns(expr: ScalarExpr) -> set[int]:
         for e in expr.exprs:
             out |= expr_columns(e)
         return out
+    if isinstance(expr, DictFunc):
+        out2: set[int] = set()
+        for e in expr.args:
+            out2 |= expr_columns(e)
+        return out2
     raise TypeError(f"not a ScalarExpr: {expr!r}")
+
+
+def expr_has_dictfunc(expr: ScalarExpr) -> bool:
+    """True if the expression tree contains a DictFunc (host-path only)."""
+    if isinstance(expr, DictFunc):
+        return True
+    if isinstance(expr, CallUnary):
+        return expr_has_dictfunc(expr.expr)
+    if isinstance(expr, CallBinary):
+        return expr_has_dictfunc(expr.left) or expr_has_dictfunc(expr.right)
+    if isinstance(expr, CallVariadic):
+        return any(expr_has_dictfunc(e) for e in expr.exprs)
+    return False

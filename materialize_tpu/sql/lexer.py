@@ -23,7 +23,7 @@ KEYWORDS = {
     "full", "outer", "on", "cross", "union", "all", "except", "intersect",
     "distinct", "create", "materialized", "view", "table", "source", "index",
     "insert", "into", "values", "delete", "drop", "show", "explain", "sink",
-    "in", "exists", "between", "like", "is", "null", "true", "false", "case",
+    "in", "exists", "between", "like", "ilike", "is", "null", "true", "false", "case",
     "when", "then", "else", "end", "cast", "asc", "desc", "with", "load",
     "generator", "for", "auction", "tpch", "counter", "subscribe", "to",
     "tables", "columns", "indexes", "sources", "views", "nulls", "first",

@@ -629,9 +629,13 @@ class Parser:
             self.next()
             op = {"!=": "<>"}.get(t.value, t.value)
             return ast.BinaryOp(op, left, self.parse_is_between_in())
-        if self.at_kw("like"):
+        if self.at_kw("like", "ilike"):
+            op = self.next().value
+            return ast.BinaryOp(op, left, self.parse_is_between_in())
+        if self.at_kw("not") and self.peek(1).value in ("like", "ilike"):
             self.next()
-            return ast.BinaryOp("like", left, self.parse_is_between_in())
+            op = "not_" + self.next().value
+            return ast.BinaryOp(op, left, self.parse_is_between_in())
         return left
 
     def parse_is_between_in(self):

@@ -146,6 +146,19 @@ def _map_window_spec(spec, fn):
     )
 
 
+def _argtype(t: PType):
+    """Decode tag for host-side multi-arg string evaluation (expr/strings.py)."""
+    if t.col == ColType.STRING:
+        return "str"
+    if t.col == ColType.NUMERIC:
+        return ("numeric", t.scale)
+    if t.col == ColType.FLOAT64:
+        return "float"
+    if t.col == ColType.BOOL:
+        return "bool"
+    return "int"
+
+
 def _literal_int(e, what: str) -> int:
     if isinstance(e, ast.NumberLit) and "." not in e.value:
         return int(e.value)
@@ -227,6 +240,10 @@ class Planner:
             # this planner does not yet)
             if v is None:
                 return Literal(None), INT
+            if not isinstance(v, str):
+                # programmatic callers may bind Python values directly; the
+                # wire path always delivers text-format strings
+                v = str(v)
             import re as _re
 
             if _re.fullmatch(r"\d{4}-\d{2}-\d{2}", v):
@@ -345,7 +362,43 @@ class Planner:
             return CallBinary("div", l, r), INT
         if op == "%":
             return CallBinary("mod", l, r), INT
+        if op in ("like", "not_like", "ilike", "not_ilike"):
+            if lt.col != ColType.STRING:
+                raise PlanError("LIKE requires a string operand")
+            ci = "ilike" in op
+            if isinstance(r, Literal) and rt.col == ColType.STRING and r.value is not None:
+                pat = self.catalog.dict.decode(r.value)
+                d = self._dictfunc(("like", pat, ci), (l,), ("str",), "bool")
+            elif rt.col == ColType.STRING:
+                d = self._dictfunc(("like_dyn", ci), (l, r), ("str", "str"), "bool")
+            else:
+                raise PlanError("LIKE pattern must be a string")
+            if op.startswith("not_"):
+                d = CallUnary("not", d)
+            return d, BOOL
+        if op == "||":
+            if ColType.STRING not in (lt.col, rt.col):
+                raise PlanError("|| requires at least one string operand")
+            if isinstance(l, Literal) and lt.col == ColType.STRING and l.value is not None:
+                lit = self.catalog.dict.decode(l.value)
+                if rt.col == ColType.STRING:
+                    return self._dictfunc(("concat_l", lit), (r,), ("str",), "string"), STRING
+            if isinstance(r, Literal) and rt.col == ColType.STRING and r.value is not None:
+                lit = self.catalog.dict.decode(r.value)
+                if lt.col == ColType.STRING:
+                    return self._dictfunc(("concat_r", lit), (l,), ("str",), "string"), STRING
+            return (
+                self._dictfunc(
+                    ("concat",), (l, r), (_argtype(lt), _argtype(rt)), "string"
+                ),
+                STRING,
+            )
         raise PlanError(f"binary op {op}")
+
+    def _dictfunc(self, spec, args, argtypes, out):
+        from ..expr.scalar import DictFunc
+
+        return DictFunc(tuple(spec), tuple(args), tuple(argtypes), out, self.catalog.str_tables)
 
     def _arith_type(self, lt: PType, rt: PType) -> PType:
         if ColType.FLOAT64 in (lt.col, rt.col):
@@ -462,6 +515,245 @@ class Planner:
             # aligned values compare; the aligned type is what decodes them
             l2, r2, t = self._align(l, lt, r, rt)
             return CallVariadic("nullif", (l2, r2)), t
+        return self._plan_scalar_func_lib(e, scope)
+
+    def _plan_scalar_func_lib(self, e: ast.FuncCall, scope: Scope):
+        """The string/math/date scalar function library.
+
+        Mirrors the accessible core of the reference's Unary/Binary/Variadic
+        function registry (src/expr/src/scalar/func/macros.rs:153; string
+        impls in func/impls/string.rs). String functions evaluate over
+        dictionary codes via host-built tables (expr/strings.py)."""
+        name = e.name
+        args = e.args
+
+        def plan(i):
+            return self.plan_scalar(args[i], scope)
+
+        def need(n_, *alts):
+            if len(args) not in (n_, *alts):
+                raise PlanError(f"{name} argument count")
+
+        def str_arg(i):
+            v, t = plan(i)
+            if t.col != ColType.STRING:
+                raise PlanError(f"{name} requires a string argument")
+            return v
+
+        def lit_str(i):
+            a = args[i]
+            if isinstance(a, ast.StringLit):
+                return a.value
+            v, t = plan(i)
+            if isinstance(v, Literal) and t.col == ColType.STRING and v.value is not None:
+                return self.catalog.dict.decode(v.value)
+            raise PlanError(f"{name}: argument {i + 1} must be a string literal")
+
+        def lit_int(i):
+            v, t = plan(i)
+            if isinstance(v, CallUnary) and v.func == "neg" and isinstance(v.expr, Literal):
+                v = Literal(-v.expr.value, v.expr.dtype)
+            if isinstance(v, Literal) and v.value is not None and t.col != ColType.STRING:
+                return int(v.value)
+            raise PlanError(f"{name}: argument {i + 1} must be an integer literal")
+
+        # -- string → string / int / bool (dictionary-table) ----------------
+        if name in ("upper", "lower", "initcap", "reverse", "md5"):
+            need(1)
+            return self._dictfunc((name,), (str_arg(0),), ("str",), "string"), STRING
+        if name in ("trim", "btrim", "ltrim", "rtrim"):
+            need(1, 2)
+            f = "trim" if name == "btrim" else name
+            spec = (f,) if len(args) == 1 else (f, lit_str(1))
+            return self._dictfunc(spec, (str_arg(0),), ("str",), "string"), STRING
+        if name in ("substr", "substring"):
+            need(2, 3)
+            ln = lit_int(2) if len(args) == 3 else None
+            spec = ("substr", lit_int(1), ln)
+            return self._dictfunc(spec, (str_arg(0),), ("str",), "string"), STRING
+        if name in ("left", "right"):
+            need(2)
+            return self._dictfunc((name, lit_int(1)), (str_arg(0),), ("str",), "string"), STRING
+        if name == "repeat":
+            need(2)
+            return self._dictfunc((name, lit_int(1)), (str_arg(0),), ("str",), "string"), STRING
+        if name in ("lpad", "rpad"):
+            need(2, 3)
+            spec = (name, lit_int(1)) if len(args) == 2 else (name, lit_int(1), lit_str(2))
+            return self._dictfunc(spec, (str_arg(0),), ("str",), "string"), STRING
+        if name == "replace":
+            need(3)
+            return (
+                self._dictfunc(
+                    ("replace", lit_str(1), lit_str(2)), (str_arg(0),), ("str",), "string"
+                ),
+                STRING,
+            )
+        if name == "split_part":
+            need(3)
+            return (
+                self._dictfunc(
+                    ("split_part", lit_str(1), lit_int(2)), (str_arg(0),), ("str",), "string"
+                ),
+                STRING,
+            )
+        if name in ("length", "char_length", "character_length"):
+            need(1)
+            return self._dictfunc(("length",), (str_arg(0),), ("str",), "int64"), INT
+        if name in ("bit_length", "octet_length", "ascii"):
+            need(1)
+            return self._dictfunc((name,), (str_arg(0),), ("str",), "int64"), INT
+        if name in ("strpos", "position"):
+            need(2)
+            s = str_arg(0)
+            try:
+                sub = lit_str(1)
+                return self._dictfunc(("strpos", sub), (s,), ("str",), "int64"), INT
+            except PlanError:
+                return (
+                    self._dictfunc(("strpos",), (s, str_arg(1)), ("str", "str"), "int64"),
+                    INT,
+                )
+        if name in ("starts_with", "ends_with"):
+            need(2)
+            s = str_arg(0)
+            try:
+                lit = lit_str(1)
+                return self._dictfunc((name, lit), (s,), ("str",), "bool"), BOOL
+            except PlanError:
+                return (
+                    self._dictfunc((name,), (s, str_arg(1)), ("str", "str"), "bool"),
+                    BOOL,
+                )
+        if name in ("concat", "concat_ws"):
+            if name == "concat_ws" and len(args) < 2:
+                raise PlanError("concat_ws needs a separator and arguments")
+            if not args:  # concat() is ''
+                return Literal(self.catalog.dict.encode("")), STRING
+            planned = [self.plan_scalar(a, scope) for a in args]
+            # pg concat treats NULL string args as ''; coalesce them so the
+            # NULL-propagating DictFunc matches (non-string NULLs still
+            # propagate — documented divergence)
+            empty = Literal(self.catalog.dict.encode(""))
+            vals, ats = [], []
+            for v, t in planned:
+                if t.col == ColType.STRING:
+                    v = CallVariadic("coalesce", (v, empty))
+                vals.append(v)
+                ats.append(_argtype(t))
+            return (
+                self._dictfunc((name,), tuple(vals), tuple(ats), "string"),
+                STRING,
+            )
+
+        # -- math -------------------------------------------------------------
+        if name in ("floor", "ceil", "ceiling", "trunc") and len(args) == 1:
+            v, t = plan(0)
+            f = "ceil" if name == "ceiling" else name
+            if t.col == ColType.NUMERIC and t.scale > 0:
+                unit = Literal(10**t.scale)
+                if f == "trunc":
+                    q = CallBinary("div", v, unit)  # truncates toward zero
+                else:
+                    q = CallBinary("fdiv" if f == "floor" else "div", v, unit)
+                    if f == "ceil":
+                        # ceil = -floor(-v)
+                        q = CallUnary("neg", CallBinary("fdiv", CallUnary("neg", v), unit))
+                return CallBinary("mul", q, unit), t
+            if t.col in (ColType.INT64, ColType.INT32) or (
+                t.col == ColType.NUMERIC and t.scale == 0
+            ):
+                return v, t
+            return CallUnary(f, _to_float(v, t)), FLOAT
+        if name == "round" and len(args) in (1, 2):
+            v, t = plan(0)
+            if t.col == ColType.NUMERIC:
+                digits = lit_int(1) if len(args) == 2 else 0
+                if digits >= t.scale:
+                    return v, t
+                # half-away-from-zero at the target digit, keep the scale
+                unit = Literal(10 ** (t.scale - digits))
+                half = Literal(10 ** (t.scale - digits) // 2)
+                pos = CallBinary("mul", CallBinary("div", CallBinary("add", v, half), unit), unit)
+                neg = CallBinary("mul", CallBinary("div", CallBinary("sub", v, half), unit), unit)
+                return (
+                    CallVariadic("if", (CallBinary("gte", v, Literal(0)), pos, neg)),
+                    t,
+                )
+            if len(args) == 2:
+                digits = lit_int(1)
+                m = Literal(float(np.float32(10.0**digits)), "float32")
+                scaled = CallBinary("mul", _to_float(v, t), m)
+                return CallBinary("div", CallUnary("round_half_away", scaled), m), FLOAT
+            if t.col in (ColType.INT64, ColType.INT32):
+                return v, t
+            return CallUnary("round_half_away", _to_float(v, t)), FLOAT
+        if name == "sign":
+            need(1)
+            v, t = plan(0)
+            return CallUnary("sign", v), (FLOAT if t.col == ColType.FLOAT64 else INT)
+        if name in ("exp", "ln", "log10", "log2", "sin", "cos", "tan", "cot",
+                    "asin", "acos", "atan", "sinh", "cosh", "tanh", "cbrt",
+                    "degrees", "radians"):
+            need(1)
+            v, t = plan(0)
+            return CallUnary(name, _to_float(v, t)), FLOAT
+        if name == "log":
+            need(1, 2)
+            if len(args) == 1:
+                v, t = plan(0)
+                return CallUnary("log10", _to_float(v, t)), FLOAT
+            b, bt = plan(0)
+            v, t = plan(1)
+            return (
+                CallBinary(
+                    "div",
+                    CallUnary("ln", _to_float(v, t)),
+                    CallUnary("ln", _to_float(b, bt)),
+                ),
+                FLOAT,
+            )
+        if name in ("power", "pow"):
+            need(2)
+            l, lt = plan(0)
+            r, rt = plan(1)
+            return CallBinary("pow", _to_float(l, lt), _to_float(r, rt)), FLOAT
+        if name == "atan2":
+            need(2)
+            l, lt = plan(0)
+            r, rt = plan(1)
+            return CallBinary("atan2", _to_float(l, lt), _to_float(r, rt)), FLOAT
+        if name == "pi":
+            need(0)
+            return Literal(float(np.float32(np.pi)), "float32"), FLOAT
+        if name == "mod":
+            need(2)
+            l, lt = plan(0)
+            r, rt = plan(1)
+            return CallBinary("mod", l, r), INT
+
+        # -- date -------------------------------------------------------------
+        if name in ("date_trunc", "date_part"):
+            need(2)
+            fld = lit_str(0).lower()
+            v, t = plan(1)
+            if name == "date_part":
+                return self.plan_scalar(
+                    ast.FuncCall(f"extract_{fld}", (args[1],)), scope
+                )
+            if fld not in ("year", "quarter", "month", "week", "day"):
+                raise PlanError(f"date_trunc field {fld!r} unsupported for DATE")
+            return CallUnary(f"date_trunc_{fld}", v), DATE
+        if name in ("extract_dow", "extract_isodow", "extract_doy",
+                    "extract_quarter", "extract_week", "extract_century",
+                    "extract_decade", "extract_millennium"):
+            need(1)
+            v, _t = plan(0)
+            return CallUnary(name, v), INT
+        if name == "extract_epoch":
+            need(1)
+            v, _t = plan(0)
+            return CallUnary("extract_epoch_date", v), INT
         raise PlanError(f"unsupported function: {name}")
 
     # -- relation planning ---------------------------------------------------
@@ -1467,14 +1759,36 @@ class Planner:
         # keys become mapped columns so the Reduce's group_key is plain columns
         arity_in = len(scope.cols)
         key_exprs = tuple(p for p, _ in key_planned)
+        # aggregate inputs holding string functions (DictFunc) are lifted into
+        # mapped columns too: the reduce kernels run under jit, where string
+        # tables cannot be evaluated — the eager Mfp stage computes them first
+        from ..expr.scalar import expr_has_dictfunc
+
+        lifted: list = []
+        for i, ag in enumerate(mir_aggs):
+            if expr_has_dictfunc(ag.expr):
+                if agg_branch[i] != 0:
+                    raise PlanError(
+                        "DISTINCT aggregates over string functions not supported"
+                    )
+                mir_aggs[i] = mir.MirAggregate(
+                    ag.func,
+                    Column(arity_in + len(key_exprs) + len(lifted)),
+                    ag.distinct,
+                )
+                lifted.append(ag.expr)
         if not distinct_branches:
-            inner = mir.MirMap(rel, key_exprs)
+            inner = mir.MirMap(rel, key_exprs + tuple(lifted))
             rel = mir.MirReduce(
                 inner,
                 group_key=tuple(range(arity_in, arity_in + len(key_exprs))),
                 aggregates=tuple(mir_aggs),
             )
         else:
+            if lifted:
+                raise PlanError(
+                    "string-function aggregates cannot mix with DISTINCT aggregates"
+                )
             rel = self._reduce_with_distinct_branches(
                 rel, arity_in, key_exprs, mir_aggs, agg_branch, distinct_branches
             )

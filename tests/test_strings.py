@@ -1,0 +1,174 @@
+"""String/math/date scalar function library (VERDICT r4 missing #2).
+
+Strings are dictionary codes on device; unary string functions evaluate as
+host-built code tables gathered per tick (expr/strings.py), LIKE compiles to
+a regex-built membership table, multi-arg functions decode host-side.
+Reference: src/expr/src/scalar/func/macros.rs:153 registry.
+"""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.expr.strings import like_to_regex, str_func_one
+
+
+@pytest.fixture()
+def coord():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int, s text)")
+    c.execute("INSERT INTO t VALUES (1, 'hello'), (2, 'World'), (3, NULL)")
+    return c
+
+
+def q(c, sql):
+    def key(row):
+        return tuple((v is not None, str(v)) for v in row)
+
+    return sorted(c.execute(sql).rows, key=key)
+
+
+def test_like_pattern_compile():
+    assert like_to_regex("h%") == "h.*"
+    assert like_to_regex("h_llo") == "h.llo"
+    assert like_to_regex("100\\%") == "100%"
+    assert like_to_regex("a.b") == "a\\.b"
+
+
+def test_str_func_one_semantics():
+    assert str_func_one(("substr", 2, 3), "hello") == "ell"
+    assert str_func_one(("substr", -1, 3), "hello") == "h"  # pg window rule
+    assert str_func_one(("substr", 3, None), "hello") == "llo"
+    assert str_func_one(("split_part", ",", 4), "a,b,c") == ""
+    assert str_func_one(("lpad", 3), "hello") == "hel"  # lpad truncates
+    assert str_func_one(("initcap",), "hi there-bob") == "Hi There-Bob"
+
+
+def test_like_ilike_not(coord):
+    assert q(coord, "SELECT s FROM t WHERE s LIKE 'h%'") == [("hello",)]
+    assert q(coord, "SELECT s FROM t WHERE s ILIKE 'w%'") == [("World",)]
+    # NULL rows never match, in either polarity (SQL 3VL)
+    assert q(coord, "SELECT s FROM t WHERE s NOT LIKE 'h%'") == [("World",)]
+    assert q(coord, "SELECT s FROM t WHERE s LIKE '%l%'") == [("World",), ("hello",)]
+    assert q(coord, "SELECT s FROM t WHERE s LIKE 'h_llo'") == [("hello",)]
+
+
+def test_unary_string_funcs(coord):
+    assert q(coord, "SELECT upper(s) FROM t") == [(None,), ("HELLO",), ("WORLD",)]
+    assert q(coord, "SELECT lower(s) FROM t") == [(None,), ("hello",), ("world",)]
+    assert q(coord, "SELECT length(s) FROM t") == [(None,), (5,), (5,)]
+    assert q(coord, "SELECT reverse(s) FROM t") == [(None,), ("dlroW",), ("olleh",)]
+    assert q(coord, "SELECT substr(s, 2, 3) FROM t") == [(None,), ("ell",), ("orl",)]
+    assert q(coord, "SELECT left(s, 2) FROM t") == [(None,), ("We"[:0] + "Wo",), ("he",)]
+    assert q(coord, "SELECT repeat(s, 2) FROM t WHERE a = 1") == [("hellohello",)]
+    assert q(coord, "SELECT replace(s, 'l', 'L') FROM t WHERE a = 1") == [("heLLo",)]
+    assert q(coord, "SELECT trim('  x  ')") == [("x",)]
+    assert q(coord, "SELECT lpad(s, 8, '*') FROM t WHERE a = 1") == [("***hello",)]
+    assert q(coord, "SELECT ascii(s) FROM t WHERE a = 2") == [(87,)]
+    assert q(coord, "SELECT strpos(s, 'l') FROM t WHERE a = 1") == [(3,)]
+    assert q(coord, "SELECT split_part('a,b,c', ',', 2)") == [("b",)]
+    assert q(coord, "SELECT initcap('hi there')") == [("Hi There",)]
+    assert q(coord, "SELECT md5('abc')") == [("900150983cd24fb0d6963f7d28e17f72",)]
+
+
+def test_concat_variants(coord):
+    assert q(coord, "SELECT a || ':' || s FROM t") == [
+        (None,),
+        ("1:hello",),
+        ("2:World",),
+    ]
+    assert q(coord, "SELECT 'x' || s FROM t WHERE a = 1") == [("xhello",)]
+    # pg concat(): NULL string args act as '' (sorted by str: 'W' < 'h')
+    assert q(coord, "SELECT concat(s, '-', a) FROM t") == [
+        ("-3",),
+        ("World-2",),
+        ("hello-1",),
+    ]
+    assert q(coord, "SELECT starts_with(s, 'he') FROM t WHERE a = 1") == [(True,)]
+
+
+def test_string_funcs_in_incremental_mv(coord):
+    coord.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT upper(s) AS u, count(*) "
+        "FROM t WHERE s LIKE '%l%' GROUP BY upper(s)"
+    )
+    assert q(coord, "SELECT * FROM mv") == [("HELLO", 1), ("WORLD", 1)]
+    # novel strings after the MV exists extend the function tables
+    coord.execute("INSERT INTO t VALUES (4, 'hull'), (5, 'hello')")
+    assert q(coord, "SELECT * FROM mv") == [("HELLO", 2), ("HULL", 1), ("WORLD", 1)]
+    coord.execute("DELETE FROM t WHERE a = 1")
+    assert q(coord, "SELECT * FROM mv") == [("HELLO", 1), ("HULL", 1), ("WORLD", 1)]
+
+
+def test_string_agg_input_lifted(coord):
+    # sum over a string function: the DictFunc is lifted into a pre-reduce
+    # map column (reduce kernels are jitted; tables are host state)
+    assert q(coord, "SELECT sum(length(s)) FROM t") == [(10,)]
+    coord.execute("CREATE MATERIALIZED VIEW lv AS SELECT sum(length(s)) AS n FROM t")
+    assert q(coord, "SELECT * FROM lv") == [(10,)]
+    coord.execute("INSERT INTO t VALUES (9, 'xy')")
+    assert q(coord, "SELECT * FROM lv") == [(12,)]
+
+
+def test_fused_render_falls_back(coord):
+    c2 = Coordinator()
+    c2.execute("ALTER SYSTEM SET enable_fused_render = true")
+    c2.execute("CREATE TABLE u (s text)")
+    c2.execute("INSERT INTO u VALUES ('aa'), ('ab'), ('bb')")
+    c2.execute(
+        "CREATE MATERIALIZED VIEW m2 AS SELECT count(*) FROM u WHERE s LIKE 'a%'"
+    )
+    assert q(c2, "SELECT * FROM m2") == [(2,)]
+    c2.execute("INSERT INTO u VALUES ('ac')")
+    assert q(c2, "SELECT * FROM m2") == [(3,)]
+
+
+def test_math_funcs(coord):
+    assert q(coord, "SELECT round(2.5), round(-2.5)") == [(3.0, -3.0)]  # half away
+    assert q(coord, "SELECT floor(2.7), ceil(2.2)") == [(2.0, 3.0)]
+    assert q(coord, "SELECT power(2, 10), sign(-5)") == [(1024.0, -1)]
+    assert q(coord, "SELECT exp(0.0), ln(1.0)") == [(1.0, 0.0)]
+    (r,) = coord.execute("SELECT log(100)").rows
+    assert abs(r[0] - 2.0) < 1e-5
+    (r,) = coord.execute("SELECT pi()").rows
+    assert abs(r[0] - 3.14159265) < 1e-5
+    assert q(coord, "SELECT abs(-3), mod(7, 3)") == [(3, 1)]
+    # round(numeric, digits) keeps numeric typing, half away from zero
+    assert q(coord, "SELECT round(2.45, 1), round(-2.45, 1)") == [(2.5, -2.5)]
+
+
+def test_date_funcs(coord):
+    from materialize_tpu.storage.generator import date_num
+
+    assert q(coord, "SELECT date_trunc('month', DATE '1995-03-17')") == [
+        (int(date_num(1995, 3, 1)),)
+    ]
+    assert q(coord, "SELECT date_trunc('year', DATE '1995-03-17')") == [
+        (int(date_num(1995, 1, 1)),)
+    ]
+    # 1995-03-17 was a Friday
+    assert q(coord, "SELECT extract(dow FROM DATE '1995-03-17')") == [(5,)]
+    assert q(coord, "SELECT extract(isodow FROM DATE '1995-03-17')") == [(5,)]
+    assert q(coord, "SELECT extract(doy FROM DATE '1995-02-01')") == [(32,)]
+    assert q(coord, "SELECT extract(quarter FROM DATE '1995-05-01')") == [(2,)]
+    # ISO week edges: 1995-01-01 (Sunday) is week 52 of 1994;
+    # 1996-12-30 (Monday) is week 1 of 1997
+    assert q(coord, "SELECT extract(week FROM DATE '1995-01-01')") == [(52,)]
+    assert q(coord, "SELECT extract(week FROM DATE '1996-12-30')") == [(1,)]
+    # date_trunc('week') = the Monday of that ISO week
+    assert q(coord, "SELECT date_trunc('week', DATE '1995-03-17')") == [
+        (int(date_num(1995, 3, 13)),)
+    ]
+
+
+def test_device_host_agree_on_dates():
+    """The device date kernels and the host interpreter share one calendar."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from materialize_tpu.expr.scalar import _DATE_UNARY, date_unary_int
+
+    days = np.array([-800, -1, 0, 1, 59, 60, 365, 366, 1154, 1171, 2922, 10000])
+    for f, fn in _DATE_UNARY.items():
+        dev = np.asarray(fn(jnp.asarray(days)))
+        host = np.array([date_unary_int(f, int(v)) for v in days])
+        assert (dev == host).all(), f
