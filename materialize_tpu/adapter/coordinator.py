@@ -1577,6 +1577,9 @@ def explain_lir(e, indent: int = 0) -> str:
             " monotonic" if getattr(e, "monotonic", False) else ""
         )
         kids = [e.input]
+    elif isinstance(e, lir.BasicAgg):
+        extra = f" keys={list(e.key_cols)} func={e.func}"
+        kids = [e.input]
     elif isinstance(e, (lir.Negate, lir.Threshold, lir.ArrangeBy, lir.TemporalFilter)):
         kids = [e.input]
     elif isinstance(e, lir.Union):

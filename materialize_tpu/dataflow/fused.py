@@ -153,7 +153,7 @@ class FusedCompiler:
 
     # -- support check ------------------------------------------------------
     def _check_supported(self, e) -> None:
-        if isinstance(e, (lir.LetRec, lir.TemporalFilter, lir.FlatMap)):
+        if isinstance(e, (lir.LetRec, lir.TemporalFilter, lir.FlatMap, lir.BasicAgg)):
             raise FusedUnsupported(type(e).__name__)
         from ..expr.scalar import expr_has_dictfunc
 
@@ -585,7 +585,9 @@ def _accum_dtypes_linear(in_dts: list, stage_i: int) -> list:
 
 
 def _children(e):
-    if isinstance(e, (lir.Mfp, lir.Negate, lir.Threshold, lir.ArrangeBy, lir.TopK)):
+    if isinstance(
+        e, (lir.Mfp, lir.Negate, lir.Threshold, lir.ArrangeBy, lir.TopK, lir.BasicAgg)
+    ):
         return (e.input,)
     if isinstance(e, lir.Reduce):
         return (e.input,)

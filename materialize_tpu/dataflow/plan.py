@@ -115,6 +115,24 @@ class Reduce:
     distinct: bool = False  # ReducePlan::Distinct
 
 
+@dataclass(frozen=True, eq=False)
+class BasicAgg:
+    """ReducePlan::Basic — order-insensitive catch-all aggregates whose value
+    is rendered from the group's full multiset of inputs (string_agg /
+    array_agg / list_agg; reference render: compute/src/render/reduce.rs:196).
+
+    Input rows are (key_cols…, element); output is (key_cols…, rendered i64
+    string code). Elements are maintained host-side as per-group multisets
+    (strings are host data in this engine — see expr/strings.py); each tick
+    re-renders only the affected groups, emitting a retract/insert pair.
+    `extra` = (delimiter | None, element argtype tag, StringDictionary)."""
+
+    input: Any
+    key_cols: tuple[int, ...]
+    func: str  # string_agg | array_agg | list_agg
+    extra: tuple
+
+
 @dataclass(frozen=True)
 class HierarchicalReduce:
     """MIN/MAX per group via the topk kernel (k=1 per aggregate)."""

@@ -329,6 +329,150 @@ class FusedMfpReduceNode(Node):
         return [("fused_reduce_accums", 1, self.state.cap, int(self.state.count()))]
 
 
+_ABSENT = object()
+
+
+class BasicAggNode(Node):
+    """ReducePlan::Basic — string_agg / array_agg / list_agg.
+
+    Maintains per-group element multisets host-side (strings are host data;
+    the device only carries dictionary codes) and re-renders affected groups
+    each tick as a retract/insert pair — the same emission discipline as the
+    accumulable reduce's (-old, +new) self-correction. Element order in the
+    rendered value is the decoded elements' sort order (deterministic under
+    churn; the reference leaves no-ORDER-BY order unspecified).
+    Reference: AggregateFunc's Basic class, render/reduce.rs:196.
+
+    Known cost: each re-render interns a new string into the engine's
+    append-only dictionary (repr/types.py StringDictionary has no eviction),
+    so a group that churns every tick grows dictionary memory by one
+    rendering per change; cycles back to a previous rendering reuse its
+    code. Tracked via state_info's rendered-bytes column so the memory
+    limiter and introspection can see it.
+    """
+
+    def __init__(self, e, in_dtypes: tuple):
+        from ..expr.scalar import null_sentinel
+
+        self.nk = len(e.key_cols)
+        self.func = e.func
+        self.delim, self.argtype, self.dct = e.extra
+        self.in_dtypes = tuple(np.dtype(d) for d in in_dtypes)
+        el_dt = self.in_dtypes[self.nk]
+        self.el_null = (
+            None if el_dt.kind == "f" else int(null_sentinel(el_dt))
+        )
+        self.groups: dict = {}  # key tuple -> {element raw value: count}
+        self.current: dict = {}  # key tuple -> emitted rendered code (or None)
+
+    def _decode_el(self, el):
+        from ..expr.strings import decode_storage_value
+
+        return decode_storage_value(self.argtype, el, self.dct, bool_style="tf")
+
+    def _render(self, multiset: dict):
+        """Rendered value (python str) or None (SQL NULL) for one group."""
+        live, nulls = [], 0
+        for el, cnt in multiset.items():
+            if cnt < 0:
+                raise ValueError("basic aggregate saw net-negative multiplicity")
+            if el is None or el == self.el_null:
+                nulls += cnt
+            else:
+                rendered = self._decode_el(el)
+                # order by VALUE (strings lexicographic, numbers numeric),
+                # not by rendered text — '9' must precede '10'
+                sk = rendered if self.argtype == "str" else el
+                live.extend([(sk, rendered)] * cnt)
+        live.sort(key=lambda p: p[0])
+        live = [r for _sk, r in live]
+        if self.func == "string_agg":
+            # string_agg skips NULL inputs; an all-NULL group is NULL
+            return self.delim.join(live) if live else None
+        # array_agg / list_agg keep NULL elements (pg semantics), NULLs last
+
+        def q(s: str) -> str:
+            if (
+                s == ""
+                or any(ch in '{},"\\' or ch.isspace() for ch in s)
+                or s.upper() == "NULL"
+            ):
+                return '"' + s.replace("\\", "\\\\").replace('"', '\\"') + '"'
+            return s
+
+        parts = [q(s) for s in live] + ["NULL"] * nulls
+        return "{" + ",".join(parts) + "}"
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        affected = set()
+        for vals, _t, diff in oks.to_rows():
+            k = tuple(vals[: self.nk])
+            el = vals[self.nk]
+            g = self.groups.setdefault(k, {})
+            g[el] = g.get(el, 0) + diff
+            if g[el] == 0:
+                del g[el]
+            if not g:
+                del self.groups[k]
+            affected.add(k)
+        out = []  # (key tuple, code-or-None, diff)
+        for k in affected:
+            old = self.current.get(k, _ABSENT)
+            if k in self.groups:
+                r = self._render(self.groups[k])
+                new = None if r is None else self.dct.encode(r)
+            else:
+                new = _ABSENT
+            if old is new or (old is not _ABSENT and new is not _ABSENT and old == new):
+                continue
+            if old is not _ABSENT:
+                out.append((k, old, -1))
+            if new is not _ABSENT:
+                out.append((k, new, 1))
+                self.current[k] = new
+            else:
+                self.current.pop(k, None)
+        if not out:
+            return None, errs
+        from ..expr.scalar import NULL_I64, null_sentinel
+
+        cols = []
+        for i in range(self.nk):
+            dt = self.in_dtypes[i]
+            fill = np.nan if dt.kind == "f" else 0
+            cols.append(
+                np.array(
+                    [fill if row[0][i] is None else row[0][i] for row in out],
+                    dtype=dt,
+                )
+            )
+        cols.append(
+            np.array(
+                [NULL_I64 if c is None else c for _k, c, _d in out], dtype=np.int64
+            )
+        )
+        times = np.full(len(out), int(tick), dtype=np.uint64)
+        diffs = np.array([d_ for _k, _c, d_ in out], dtype=np.int64)
+        batch = UpdateBatch.build((), tuple(cols), times, diffs)
+        return batch, errs
+
+    def state_info(self):
+        n = sum(len(g) for g in self.groups.values())
+        rendered_bytes = sum(
+            0 if c is None else len(self.dct.decode(c)) for c in self.current.values()
+        )
+        return [
+            ("basic_agg_groups", 1, max(n, 1), len(self.groups)),
+            ("basic_agg_rendered_bytes", 1, max(rendered_bytes, 1), rendered_bytes),
+        ]
+
+
 class DistinctNode(Node):
     """ReducePlan::Distinct — project to key cols, then presence per row."""
 
@@ -856,6 +1000,10 @@ class Dataflow:
             else:
                 ops.append((ReduceNode(e, in_dt), [ref]))
             return len(ops) - 1
+        if isinstance(e, lir.BasicAgg):
+            ref = self._render(e.input, ops)
+            ops.append((BasicAggNode(e, self._infer_dtypes(e.input)), [ref]))
+            return len(ops) - 1
         if isinstance(e, lir.Threshold):
             ref = self._render(e.input, ops)
             ops.append((ThresholdNode(self._infer_dtypes(e.input)), [ref]))
@@ -913,6 +1061,9 @@ class Dataflow:
             return tuple(ins[i] for i in e.key_cols) + tuple(
                 agg_out_dtype(a) for a in e.aggs
             )
+        if isinstance(e, lir.BasicAgg):
+            ins = self._infer_dtypes(e.input)
+            return tuple(ins[i] for i in e.key_cols) + (np.dtype(np.int64),)
         if isinstance(e, lir.Join):
             cols = []
             for i in e.inputs:

@@ -70,11 +70,17 @@ class MirJoin:
 
 @dataclass(frozen=True)
 class MirAggregate:
-    """func in {sum,count,min,max,avg is planned as sum/count}; expr over input cols."""
+    """func in {sum,count,min,max,avg is planned as sum/count} plus the Basic
+    class (string_agg/array_agg/list_agg — reference AggregateFunc's
+    catch-all, src/expr/src/relation/func.rs:1878); expr over input cols.
+
+    `extra` carries Basic-aggregate rendering state: (delimiter | None,
+    element argtype tag, StringDictionary ref)."""
 
     func: str
     expr: ScalarExpr
     distinct: bool = False
+    extra: tuple | None = None
 
 
 @dataclass(frozen=True)

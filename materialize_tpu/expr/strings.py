@@ -289,23 +289,33 @@ class StringFuncTables:
         return out, oob
 
     def _decode_arg(self, argtype, v):
-        """Decode one encoded scalar per its planner type tag."""
-        if isinstance(argtype, tuple) and argtype[0] == "numeric":
-            scale = argtype[1]
-            iv = int(v)
-            sign = "-" if iv < 0 else ""
-            iv = abs(iv)
-            if scale:
-                return f"{sign}{iv // 10**scale}.{iv % 10**scale:0{scale}d}"
-            return f"{sign}{iv}"
-        if argtype == "str":
-            return self.dct.decode(int(v))
-        if argtype == "bool":
-            return "true" if v else "false"
-        if argtype == "float":
-            return repr(float(np.float32(v)))
-        if argtype == "int":
-            return str(int(v))
-        if argtype == "raw":  # already a Python value (host interpreter)
-            return v
-        raise TypeError(f"bad argtype {argtype!r}")
+        return decode_storage_value(argtype, v, self.dct)
+
+
+def decode_storage_value(argtype, v, dct, bool_style: str = "word"):
+    """Text form of one encoded storage scalar per its planner type tag.
+
+    The single decode shared by multi-arg string evaluation and basic
+    aggregates. `bool_style`: "word" → true/false (cast form), "tf" → t/f
+    (pg array-element form)."""
+    if isinstance(argtype, tuple) and argtype[0] == "numeric":
+        scale = argtype[1]
+        iv = int(v)
+        sign = "-" if iv < 0 else ""
+        iv = abs(iv)
+        if scale:
+            return f"{sign}{iv // 10**scale}.{iv % 10**scale:0{scale}d}"
+        return f"{sign}{iv}"
+    if argtype == "str":
+        return dct.decode(int(v))
+    if argtype == "bool":
+        if bool_style == "tf":
+            return "t" if v else "f"
+        return "true" if v else "false"
+    if argtype == "float":
+        return repr(float(np.float32(v)))
+    if argtype == "int":
+        return str(int(v))
+    if argtype == "raw":  # already a Python value (host interpreter)
+        return v
+    raise TypeError(f"bad argtype {argtype!r}")

@@ -59,6 +59,8 @@ class Lowerer:
             for a in e.aggregates:
                 if a.func == "count":
                     out.append(I64)
+                elif a.func in ("string_agg", "array_agg", "list_agg"):
+                    out.append(I64)  # rendered string code
                 else:
                     out.append(_expr_np_dtype(a.expr, list(base)))
             return tuple(out)
@@ -253,7 +255,7 @@ class Lowerer:
         out_dtypes = self.dtypes(e)
         defaults = tuple(
             null_sentinel(dt)
-            if a.func in ("min", "max")
+            if a.func in ("min", "max", "string_agg", "array_agg", "list_agg")
             else (0 if np.issubdtype(dt, np.integer) else np.float32(0.0))
             for a, dt in zip(e.aggregates, out_dtypes)
         )
@@ -291,9 +293,15 @@ class Lowerer:
             return lir.Reduce(self.lower(e.input), key_cols=key, distinct=True)
 
         parts = []  # (agg_indices, lir builder fn)
+        _BASIC = ("string_agg", "array_agg", "list_agg")
         acc_idx = [i for i, a in enumerate(e.aggregates) if a.func in ("sum", "count")]
         hier_idx = [i for i, a in enumerate(e.aggregates) if a.func in ("min", "max")]
-        unknown = [a.func for a in e.aggregates if a.func not in ("sum", "count", "min", "max")]
+        basic_idx = [i for i, a in enumerate(e.aggregates) if a.func in _BASIC]
+        unknown = [
+            a.func
+            for a in e.aggregates
+            if a.func not in ("sum", "count", "min", "max") + _BASIC
+        ]
         if unknown:
             raise NotImplementedError(f"aggregates {unknown}")
 
@@ -349,17 +357,34 @@ class Lowerer:
             )
             return topk
 
-        if acc_idx and not hier_idx:
+        def basic_part(agg_i: int):
+            # ReducePlan::Basic: materialize (keys, element) and hand the
+            # multiset to the BasicAgg host operator (render/reduce.rs:196)
+            a = e.aggregates[agg_i]
+            n_in = len(in_dtypes)
+            b = MfpBuilder(n_in)
+            b.add_maps((a.expr,))
+            b.project(tuple(key) + (n_in,))
+            pre = lir.Mfp(lowered_in, b.finish())
+            nk = len(key)
+            return lir.BasicAgg(
+                pre, key_cols=tuple(range(nk)), func=a.func, extra=a.extra
+            )
+
+        if acc_idx and not hier_idx and not basic_idx:
             return accumulable_part()
-        if len(hier_idx) == 1 and not acc_idx:
-            part = hierarchical_part(hier_idx[0])
-            return part
+        if len(hier_idx) == 1 and not acc_idx and not basic_idx:
+            return hierarchical_part(hier_idx[0])
+        if len(basic_idx) == 1 and not acc_idx and not hier_idx:
+            return basic_part(basic_idx[0])
         # collation: join partial reduces on the group key
         partials = []  # (lir expr, agg indices, out arity)
         if acc_idx:
             partials.append((accumulable_part(), acc_idx))
         for hi in hier_idx:
             partials.append((hierarchical_part(hi), [hi]))
+        for bi in basic_idx:
+            partials.append((basic_part(bi), [bi]))
         nk = len(key)
         # every partial outputs (key cols ++ its agg cols)
         stages = []

@@ -111,7 +111,9 @@ _AGG_FUNCS = {
     "sum", "count", "min", "max", "avg",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or",
+    "string_agg", "array_agg", "list_agg",
 }
+_BASIC_AGGS = {"string_agg", "array_agg", "list_agg"}
 
 
 @dataclass(frozen=True)
@@ -1742,6 +1744,32 @@ class Planner:
                 cnt_i = emit(bid, mir.MirAggregate("count", v))
                 post_agg_exprs.append(("sumn", (sum_i, cnt_i, vt), vt))
                 agg_types.extend([vt, INT])
+            elif fname in _BASIC_AGGS:
+                # Basic reduces (reference ReducePlan::Basic): the group's
+                # input multiset renders to one value at emission. Output is
+                # always STRING (string_agg text; array/list aggs render
+                # their pg text form — the engine has no array ADT).
+                if a.distinct:
+                    raise PlanError(f"DISTINCT {fname} not supported")
+                if fname != "string_agg" and len(a.args) != 1:
+                    raise PlanError(f"{fname} takes exactly one argument")
+                if not a.args:
+                    raise PlanError(f"{fname} needs an argument")
+                v, vt = self.plan_scalar(a.args[0], scope)
+                delim = None
+                if fname == "string_agg":
+                    if len(a.args) != 2:
+                        raise PlanError("string_agg takes (value, delimiter)")
+                    if vt.col != ColType.STRING:
+                        raise PlanError("string_agg requires a string value")
+                    d, dt_ = self.plan_scalar(a.args[1], scope)
+                    if not (isinstance(d, Literal) and dt_.col == ColType.STRING):
+                        raise PlanError("string_agg delimiter must be a string literal")
+                    delim = self.catalog.dict.decode(d.value)
+                extra = (delim, _argtype(vt), self.catalog.dict)
+                i = emit(0, mir.MirAggregate(fname, v, extra=extra))
+                post_agg_exprs.append(("col", i, STRING))
+                agg_types.append(STRING)
             elif fname in ("bool_and", "bool_or"):
                 # all/any over non-NULL inputs = min/max over the stored
                 # int8 truth values (func.rs All/Any accumulation)
@@ -1775,6 +1803,7 @@ class Planner:
                     ag.func,
                     Column(arity_in + len(key_exprs) + len(lifted)),
                     ag.distinct,
+                    ag.extra,
                 )
                 lifted.append(ag.expr)
         if not distinct_branches:
