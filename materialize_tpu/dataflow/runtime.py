@@ -372,7 +372,7 @@ class BasicAggNode(Node):
 
     def _render(self, multiset: dict):
         """Rendered value (python str) or None (SQL NULL) for one group."""
-        live, nulls = [], 0
+        distinct, nulls = [], 0
         for el, cnt in multiset.items():
             if cnt < 0:
                 raise ValueError("basic aggregate saw net-negative multiplicity")
@@ -383,9 +383,18 @@ class BasicAggNode(Node):
                 # order by VALUE (strings lexicographic, numbers numeric),
                 # not by rendered text — '9' must precede '10'
                 sk = rendered if self.argtype == "str" else el
-                live.extend([(sk, rendered)] * cnt)
-        live.sort(key=lambda p: p[0])
-        live = [r for _sk, r in live]
+                distinct.append((sk, rendered, cnt))
+        if self.func in ("min_str", "max_str"):
+            # min/max over decoded strings (device top-1 would rank by
+            # dictionary code — insertion order, not collation); O(distinct),
+            # no multiplicity expansion
+            if not distinct:
+                return None
+            pick = min if self.func == "min_str" else max
+            return pick(distinct, key=lambda p: p[0])[1]
+        live = []
+        for sk, rendered, cnt in sorted(distinct, key=lambda p: p[0]):
+            live.extend([rendered] * cnt)
         if self.func == "string_agg":
             # string_agg skips NULL inputs; an all-NULL group is NULL
             return self.delim.join(live) if live else None

@@ -341,6 +341,24 @@ class Planner:
         l, lt = self.plan_scalar(e.left, scope)
         r, rt = self.plan_scalar(e.right, scope)
         if op in ("=", "<>", "<", "<=", ">", ">="):
+            if (
+                op not in ("=", "<>")
+                and ColType.STRING in (lt.col, rt.col)
+            ):
+                # dictionary codes are insertion-ordered: inequality must
+                # compare DECODED strings (host path; fused falls back).
+                # Equality on codes stays exact and device-native.
+                if isinstance(l, Literal) and l.value is None:
+                    return Literal(None, "int8"), BOOL  # NULL cmp is NULL
+                if isinstance(r, Literal) and r.value is None:
+                    return Literal(None, "int8"), BOOL
+                if lt.col != rt.col:
+                    raise PlanError("cannot compare string with non-string")
+                fn = {"<": "str_lt", "<=": "str_lte", ">": "str_gt", ">=": "str_gte"}[op]
+                return (
+                    self._dictfunc((fn,), (l, r), ("str", "str"), "bool"),
+                    BOOL,
+                )
             l, r, _t = self._align(l, lt, r, rt)
             fn = {"=": "eq", "<>": "ne", "<": "lt", "<=": "lte", ">": "gt", ">=": "gte"}[op]
             return CallBinary(fn, l, r), BOOL
@@ -1526,7 +1544,14 @@ class Planner:
             npart = len(map_exprs)
             part_cols = tuple(range(cur, cur + npart))
             for o in spec.order_by:
-                oe, _ot = self.plan_scalar(o.expr, scope)
+                oe, ot = self.plan_scalar(o.expr, scope)
+                if ot.col == ColType.STRING:
+                    # the window kernel ranks on device by dictionary code
+                    # (insertion order) — reject rather than mis-order
+                    raise PlanError(
+                        "window ORDER BY on a string column is not supported "
+                        "(device ordering is by dictionary code)"
+                    )
                 map_exprs.append(oe)
             ord_cols = tuple(range(cur + npart, cur + npart + len(spec.order_by)))
             order_by = tuple(
@@ -1583,6 +1608,11 @@ class Planner:
                     pending.append((wi, "col", (k0 + len(funcs) - 1, vt)))
                 elif name in ("first_value", "last_value", "sum", "min", "max", "count"):
                     acol, vt = arg_col(call.args[0])
+                    if name in ("min", "max") and vt.col == ColType.STRING:
+                        raise PlanError(
+                            f"window {name} over a string column is not "
+                            "supported (device ordering is by dictionary code)"
+                        )
                     out_t = INT if name == "count" else vt
                     funcs.append(mir.MirWindowFunc(name, acol))
                     pending.append((wi, "col", (k0 + len(funcs) - 1, out_t)))
@@ -1780,7 +1810,13 @@ class Planner:
             else:
                 v, vt = self.plan_scalar(a.args[0], scope)
                 out_t = vt if fname != "count" else INT
-                i = emit(0, mir.MirAggregate(fname, v))
+                if fname in ("min", "max") and vt.col == ColType.STRING:
+                    # device top-1 would rank by dictionary code; route
+                    # through the Basic class, which compares decoded strings
+                    extra = (None, "str", self.catalog.dict)
+                    i = emit(0, mir.MirAggregate(f"{fname}_str", v, extra=extra))
+                else:
+                    i = emit(0, mir.MirAggregate(fname, v))
                 post_agg_exprs.append(("col", i, out_t))
                 agg_types.append(out_t)
 
@@ -2217,7 +2253,23 @@ def _default_name(e) -> str:
 
 
 def _apply_finishing_as_topk(pq: PlannedQuery):
-    """LIMIT inside a view body becomes a TopK (global group)."""
+    """LIMIT inside a view body becomes a TopK (global group).
+
+    Rejected for STRING order columns when rows are actually dropped
+    (LIMIT/OFFSET): a maintained TopK ranks rows on device by dictionary
+    code (insertion order, not collation), which would silently mis-order.
+    Without LIMIT/OFFSET the TopK keeps every row, so ordering is
+    semantically inert (relations are unordered) and stays allowed. One-shot
+    peeks are unaffected — their finishing sorts decoded strings host-side
+    (coordinator._finish)."""
+    if pq.finishing.limit is not None or pq.finishing.offset:
+        for col, _desc in pq.finishing.order_by:
+            if pq.scope.cols[col].typ.col == ColType.STRING:
+                raise PlanError(
+                    "ORDER BY on a string column with LIMIT is not supported "
+                    "in maintained views (device ordering is by dictionary "
+                    "code)"
+                )
     return mir.MirTopK(
         pq.mir,
         group_key=(),

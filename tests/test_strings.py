@@ -160,6 +160,42 @@ def test_date_funcs(coord):
     ]
 
 
+def test_string_ordering_is_lexicographic(coord):
+    """VERDICT r4 weak #6: nothing may rank strings by dictionary code."""
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int, s text)")
+    # insertion order is deliberately anti-lexicographic
+    c.execute("INSERT INTO t VALUES (1,'zebra'),(2,'apple'),(3,'Mango'),(4,NULL)")
+    # inequality comparisons decode (host path)
+    assert sorted(c.execute("SELECT s FROM t WHERE s > 'apple'").rows) == [("zebra",)]
+    assert sorted(c.execute("SELECT s FROM t WHERE s <= 'apple'").rows) == [
+        ("Mango",),
+        ("apple",),
+    ]
+    # min/max route through the Basic class (decoded comparison)
+    assert c.execute("SELECT min(s), max(s) FROM t").rows == [("Mango", "zebra")]
+    # maintained incrementally
+    c.execute("CREATE MATERIALIZED VIEW m AS SELECT min(s) AS lo FROM t")
+    c.execute("INSERT INTO t VALUES (5,'Aardvark')")
+    assert c.execute("SELECT * FROM m").rows == [("Aardvark",)]
+    c.execute("DELETE FROM t WHERE s = 'Aardvark'")
+    assert c.execute("SELECT * FROM m").rows == [("Mango",)]
+    # one-shot ORDER BY sorts decoded strings host-side
+    assert c.execute("SELECT s FROM t WHERE s IS NOT NULL ORDER BY s LIMIT 2").rows == [
+        ("Mango",),
+        ("apple",),
+    ]
+    # a maintained TopK over strings is cleanly rejected, not silently wrong
+    from materialize_tpu.sql.plan import PlanError
+
+    with pytest.raises(PlanError):
+        c.execute("CREATE MATERIALIZED VIEW bad AS SELECT s FROM t ORDER BY s LIMIT 2")
+    with pytest.raises(PlanError):
+        c.execute("SELECT min(s) OVER (PARTITION BY a) FROM t")
+    # NULL comparisons are NULL (3VL), not errors
+    assert c.execute("SELECT s FROM t WHERE s > NULL").rows == []
+
+
 def test_device_host_agree_on_dates():
     """The device date kernels and the host interpreter share one calendar."""
     import jax.numpy as jnp
