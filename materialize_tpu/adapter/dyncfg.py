@@ -105,7 +105,20 @@ FUSED_RENDER = Config(
     "ENABLE_MZ_JOIN_CORE-style rendering toggle for the fused path)",
 )
 
+MV_SINK_SELF_CORRECT = Config(
+    "mv_sink_self_correct_interval",
+    16,
+    "every N write ticks, diff each materialized view's desired output (its "
+    "index trace) against the persisted collection and append the "
+    "correction (0 = off, 1 = every tick) — bounds the blast radius of any "
+    "bug that corrupts a derived collection at O(view) cost per check (the "
+    "reference's self-correcting persist_sink maintains this diff "
+    "incrementally, src/compute/src/sink/materialized_view.rs:9-37; here "
+    "the full diff is amortized over the interval)",
+)
+
 ALL_CONFIGS = [
+    MV_SINK_SELF_CORRECT,
     ENABLE_DELTA_JOIN,
     DELTA_JOIN_MAX_INPUTS,
     LSM_MERGE_RATIO,

@@ -231,3 +231,6 @@ class DataflowDescription:
     index_exports: dict  # index id -> (object id, key_cols)
     sink_exports: dict = field(default_factory=dict)  # sink id -> object id
     as_of: int = 0
+    # outputs at times >= until are not needed (None = unbounded); one-shot
+    # peek dataflows set until = as_of + 1 (reference dataflows.rs:54-74)
+    until: int | None = None

@@ -905,10 +905,46 @@ class Dataflow:
             self.index_traces[idx_id] = Arrangement(key_cols=tuple(key_cols))
             self.index_errs[idx_id] = Arrangement(key_cols=())
         self.sink_outputs: dict[str, list] = {s: [] for s in desc.sink_exports}
-        self.frontier = desc.as_of
+        from .antichain import EMPTY, Antichain
+
+        self._frontier = Antichain.of(desc.as_of)
+        self._last_complete = desc.as_of - 1
+        # `until`: outputs at times ≥ until are not needed; empty = unbounded
+        # (reference dataflows.rs:54-74 — one-shot peek dataflows set
+        # until = as_of+1 so temporal filters need not emit the future)
+        self.until = (
+            Antichain.of(desc.until) if getattr(desc, "until", None) is not None
+            else EMPTY
+        )
         # (obj_id, op_idx) -> {type, elapsed_ns, invocations}; the analogue of
         # the reference's timely/compute introspection logs (SURVEY.md §5)
         self.metrics: dict = {}
+
+    # -- frontier ----------------------------------------------------------
+    @property
+    def frontier(self) -> int:
+        """Scalar view of the write frontier (u64 max when complete)."""
+        return self._frontier.as_scalar((1 << 64) - 1)
+
+    @frontier.setter
+    def frontier(self, tick: int) -> None:
+        """Advance the frontier; crossing `until` closes the dataflow
+        (frontier becomes the EMPTY antichain: nothing more will change)."""
+        from .antichain import EMPTY, Antichain
+
+        self._last_complete = max(self._last_complete, int(tick) - 1)
+        if self.until and self.until.less_equal(int(tick)):
+            self._frontier = EMPTY
+        else:
+            self._frontier = Antichain.of(int(tick))
+
+    @property
+    def frontier_antichain(self):
+        return self._frontier
+
+    def is_complete(self) -> bool:
+        """True once the frontier is empty — no future update can appear."""
+        return self._frontier.is_empty()
 
     def operator_info(self) -> list:
         """[(obj_id, op_idx, type, elapsed_ns, invocations)] per operator."""
@@ -1116,6 +1152,11 @@ class Dataflow:
                 m["elapsed_ns"] += _time.perf_counter_ns() - t0
                 m["invocations"] += 1
             out = env.get(out_ref) if isinstance(out_ref, str) else slots[out_ref]
+            if self.until and out is not None:
+                out = (
+                    _truncate_until(out[0], self.until.elements[0]),
+                    _truncate_until(out[1], self.until.elements[0]),
+                )
             env[obj_id] = out
             results[obj_id] = out
         for idx_id, (obj_id, _k) in self.desc.index_exports.items():
@@ -1136,8 +1177,31 @@ class Dataflow:
     def peek(self, index_id: str, at: Optional[int] = None) -> list[tuple]:
         """Snapshot read of an exported index at time `at` (default: latest
         complete time). The analogue of PendingPeek::Index cursor scans
-        (src/compute/src/compute_state.rs:1273)."""
-        at = self.frontier - 1 if at is None else at
+        (src/compute/src/compute_state.rs:1273).
+
+        Frontier discipline (the reference's since ≤ at < upper peek
+        invariant, src/adapter/src/coord.rs:22-66): a peek below `since`
+        reads compacted history whose times were forwarded — the snapshot
+        would be silently partial, so it errors; a peek at/after the write
+        frontier reads incomplete data, so it errors (the controller only
+        issues peeks once ProcessTo has advanced past `at`)."""
+        if at is None:
+            at = (
+                self._last_complete
+                if self._frontier.is_empty()
+                else self.frontier - 1
+            )
+        since = self.index_traces[index_id].since
+        if at < since:
+            raise RuntimeError(
+                f"peek at time {at} is below the since frontier {since}: "
+                "that history has been compacted away"
+            )
+        if self._frontier and at >= self.frontier:
+            raise RuntimeError(
+                f"peek at time {at} is not beyond the write frontier "
+                f"{self.frontier}: the result would be incomplete"
+            )
         acc: dict[tuple, int] = {}
         for data, _t, d in self.index_errs[index_id].rows_host(at):
             acc[data] = acc.get(data, 0) + d
@@ -1156,6 +1220,25 @@ class Dataflow:
             arr.compact(since)
         for arr in self.index_errs.values():
             arr.compact(since)
+
+
+def _truncate_until(b: Optional[UpdateBatch], until: int) -> Optional[UpdateBatch]:
+    """Suppress updates at times ≥ until (they are not needed by anyone —
+    reference dataflows.rs `until` semantics). Rows keep their slots with
+    diff 0 / PAD hash, the engine-wide dead-row discipline."""
+    if b is None:
+        return None
+    from ..repr.batch import PAD_TIME
+    from ..repr.hashing import PAD_HASH
+
+    keep = b.times < jnp.uint64(until)
+    return UpdateBatch(
+        jnp.where(keep, b.hashes, PAD_HASH),
+        b.keys,
+        b.vals,
+        jnp.where(keep, b.times, PAD_TIME),
+        jnp.where(keep, b.diffs, 0),
+    )
 
 
 def _expr_dtype(expr, col_dtypes):

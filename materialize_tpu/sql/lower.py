@@ -445,6 +445,7 @@ def lower_to_dataflow(
     index_key: tuple = (),
     as_of: int = 0,
     mono_ids: set | None = None,
+    until: int | None = None,
 ) -> DataflowDescription:
     """Build a one-object DataflowDescription for `mir_expr`."""
     lo = Lowerer(dtypes_env, mono_ids)
@@ -455,4 +456,5 @@ def lower_to_dataflow(
         objects_to_build=[BuildDesc(obj_id, plan, out_dtypes)],
         index_exports={f"idx_{obj_id}": (obj_id, tuple(index_key))},
         as_of=as_of,
+        until=until,
     )

@@ -1,0 +1,83 @@
+"""Self-correcting MV persist sink (VERDICT r4 #7).
+
+Each tick the coordinator diffs every materialized view's desired output
+(its dataflow index trace) against the persisted storage collection and
+appends the correction — so corruption of a derived collection heals instead
+of persisting forever. Reference: src/compute/src/sink/materialized_view.rs:9-37.
+"""
+
+import numpy as np
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.repr import UpdateBatch
+
+
+def _corrupt(coord, gid, row_vals, t, diff):
+    """Inject a bogus update directly into the storage collection,
+    bypassing the dataflow — simulating a corrupted derived shard."""
+    cols = tuple(np.array([v], dtype=dt) for v, dt in zip(row_vals, coord.storage[gid].dtypes))
+    batch = UpdateBatch.build(
+        (), cols, np.array([t], dtype=np.uint64), np.array([diff], dtype=np.int64)
+    )
+    coord.storage[gid].arr.insert(batch)
+
+
+def _mv_gid(coord, name):
+    return coord.catalog.get(name).global_id
+
+
+def test_injected_corruption_heals():
+    c = Coordinator()
+    c.execute("ALTER SYSTEM SET mv_sink_self_correct_interval = 1")
+    c.execute("CREATE TABLE t (g int, v int)")
+    c.execute("CREATE MATERIALIZED VIEW m AS SELECT g, sum(v) AS s FROM t GROUP BY g")
+    c.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    assert sorted(c.execute("SELECT * FROM m").rows) == [(1, 10), (2, 20)]
+
+    def net(gid):
+        acc: dict = {}
+        for d, _t, n in c.storage[gid].arr.rows_host():
+            acc[d] = acc.get(d, 0) + n
+        return {d: n for d, n in acc.items() if n != 0}
+
+    gid = _mv_gid(c, "m")
+    # corrupt: phantom row + a retraction of a real row
+    _corrupt(c, gid, (9, 999), 2, +1)
+    _corrupt(c, gid, (1, 10), 2, -1)
+    # the corruption is visible to a raw read of the collection...
+    raw = net(gid)
+    assert raw.get((9, 999)) == 1 and (1, 10) not in raw
+
+    # ...and the next tick's sink correction heals it
+    c.execute("INSERT INTO t VALUES (3, 30)")
+    assert getattr(c, "mv_corrections", 0) >= 2
+    assert sorted(c.execute("SELECT * FROM m").rows) == [(1, 10), (2, 20), (3, 30)]
+    assert net(gid) == {(1, 10): 1, (2, 20): 1, (3, 30): 1}
+
+
+def test_healthy_ticks_append_no_corrections():
+    c = Coordinator()
+    c.execute("ALTER SYSTEM SET mv_sink_self_correct_interval = 1")
+    c.execute("CREATE TABLE t (v int)")
+    c.execute("CREATE MATERIALIZED VIEW m AS SELECT sum(v) FROM t")
+    for i in range(5):
+        c.execute(f"INSERT INTO t VALUES ({i})")
+    c.execute("DELETE FROM t WHERE v = 2")
+    assert c.execute("SELECT * FROM m").rows == [(8,)]
+    assert getattr(c, "mv_corrections", 0) == 0
+
+
+def test_self_correct_can_be_disabled():
+    c = Coordinator()
+    c.execute("ALTER SYSTEM SET mv_sink_self_correct_interval = 0")
+    c.execute("CREATE TABLE t (v int)")
+    c.execute("CREATE MATERIALIZED VIEW m AS SELECT sum(v) FROM t")
+    c.execute("INSERT INTO t VALUES (1)")
+    gid = _mv_gid(c, "m")
+    _corrupt(c, gid, (42,), 2, +1)
+    c.execute("INSERT INTO t VALUES (2)")
+    # corruption persists when the knob is off (and reads reflect it)
+    acc: dict = {}
+    for d, _t, n in c.storage[gid].arr.rows_host():
+        acc[d] = acc.get(d, 0) + n
+    assert acc.get((42,)) == 1

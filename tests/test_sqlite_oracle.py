@@ -178,6 +178,24 @@ class Oracle:
                     f"WHERE {name}.{a} = {n2}.{b}"
                 )
                 return q
+        if r < 0.6:
+            # ORDER BY all selected columns + LIMIT: ordering by the FULL
+            # row makes the limited prefix a well-defined multiset (ties are
+            # identical rows), so both engines must return the same rows.
+            # Explicit NULLS FIRST/LAST pins the engines' differing defaults.
+            sel_cols = [c for c, _t in cols][: int(self.rng.integers(1, 4))]
+            order = []
+            for sc in sel_cols:
+                if self.rng.random() < 0.5:
+                    order.append(f"{sc} ASC NULLS FIRST")
+                else:
+                    order.append(f"{sc} DESC NULLS LAST")
+            k = int(self.rng.integers(1, 8))
+            q = f"SELECT {', '.join(sel_cols)} FROM {name}"
+            if self.rng.random() < 0.5:
+                q += f" WHERE {self.pred(cols)}"
+            q += f" ORDER BY {', '.join(order)} LIMIT {k}"
+            return q
         # plain select
         items = []
         for _ in range(int(self.rng.integers(1, 4))):
