@@ -720,12 +720,15 @@ class Coordinator:
             return self.catalog.dict.encode(str(v))
         if isinstance(e, ast.NumberLit):
             if "e" in e.value or "E" in e.value:  # scientific notation
-                f = float(e.value)
-                if cdesc.typ == ColType.NUMERIC:
-                    return int(round(f * 10**cdesc.scale))
+                # expand the exponent exactly and reuse the plain-decimal
+                # path, so '2.678' and '2.678e0' encode identically
+                # (truncation, not rounding — advisor r4)
+                from decimal import Decimal
+
+                txt = format(Decimal(e.value), "f")
                 if cdesc.typ in (ColType.INT64, ColType.INT32):
-                    return int(f)
-                return f
+                    return int(Decimal(e.value))
+                return self._literal_value(ast.NumberLit(txt), cdesc)
             if cdesc.typ == ColType.NUMERIC:
                 if "." in e.value:
                     # sign applies to the WHOLE value: int('-1')*100 + 50 would
@@ -737,7 +740,8 @@ class Coordinator:
                     return -mag if neg else mag
                 return int(e.value) * 10**cdesc.scale
             if "." in e.value:
-                return float(e.value)
+                # f32 like plan.py's literal typing — host and device agree
+                return float(np.float32(e.value))
             return int(e.value)
         if isinstance(e, ast.StringLit):
             return self.catalog.dict.encode(e.value)
@@ -1040,8 +1044,14 @@ class Coordinator:
         for mv_gid, df, src_gids in self.dataflows:
             deltas = {g: env[g] for g in src_gids if g in env}
             if not deltas and not df.has_temporal:
-                # quiet dataflow; temporal ones must still see time pass
+                # quiet dataflow; temporal ones must still see time pass —
+                # but sink correction still runs (an idle view's corrupted
+                # collection must heal even with no source deltas)
                 df.frontier = ts + 1
+                if correct:
+                    corr = self._mv_sink_correct(mv_gid, df, ts)
+                    if corr is not None:
+                        corrections[mv_gid] = corr
                 continue
             results = df.step(ts, deltas)
             out = results.get(mv_gid)
@@ -1759,7 +1769,21 @@ def _eval_scalar_on_row(e, row: list):
             "add": lambda: f32(np.float32(l) + np.float32(r)) if fl else l + r,
             "sub": lambda: f32(np.float32(l) - np.float32(r)) if fl else l - r,
             "mul": lambda: f32(np.float32(l) * np.float32(r)) if fl else l * r,
-            "mod": lambda: l - r * (abs(l) // abs(r)) * (1 if (l < 0) == (r < 0) else -1),
+            # float mod mirrors the device's f32 kernel step-for-step
+            # (advisor r4: f64 host arithmetic could disagree with a
+            # rendered dataflow for float operands)
+            "mod": lambda: (
+                f32(
+                    np.float32(l)
+                    - np.float32(r)
+                    * np.float32(
+                        (np.abs(np.float32(l)) // np.abs(np.float32(r)))
+                        * (1 if (l < 0) == (r < 0) else -1)
+                    )
+                )
+                if fl
+                else l - r * (abs(l) // abs(r)) * (1 if (l < 0) == (r < 0) else -1)
+            ),
             "pow": lambda: f32(np.power(np.float32(l), np.float32(r))),
             "atan2": lambda: f32(np.arctan2(np.float32(l), np.float32(r))),
             "eq": lambda: l == r,

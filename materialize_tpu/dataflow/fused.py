@@ -153,9 +153,15 @@ class FusedCompiler:
 
     # -- support check ------------------------------------------------------
     def _check_supported(self, e) -> None:
-        if isinstance(e, (lir.LetRec, lir.TemporalFilter, lir.FlatMap, lir.BasicAgg)):
+        if isinstance(e, (lir.LetRec, lir.TemporalFilter, lir.BasicAgg)):
             raise FusedUnsupported(type(e).__name__)
         from ..expr.scalar import expr_has_dictfunc
+
+        if isinstance(e, lir.FlatMap):
+            if e.func != "generate_series" or any(
+                expr_has_dictfunc(x) for x in e.exprs
+            ):
+                raise FusedUnsupported("FlatMap")
 
         def no_dictfunc(exprs):
             # string-function tables are host state; they cannot bake into a
@@ -190,6 +196,10 @@ class FusedCompiler:
             return tuple(cols)
         if isinstance(e, (lir.Negate, lir.Threshold, lir.ArrangeBy)):
             return self.infer_dtypes(e.input)
+        if isinstance(e, lir.FlatMap):
+            import numpy as _np
+
+            return self.infer_dtypes(e.input) + (_np.dtype(_np.int64),)
         if isinstance(e, lir.Union):
             return self.infer_dtypes(e.inputs[0])
         if isinstance(e, lir.TopK):
@@ -338,6 +348,16 @@ class FusedCompiler:
             for p in parts[1:]:
                 acc = UpdateBatch.concat(acc, p)
             return consolidate(acc)
+        if isinstance(e, lir.FlatMap):
+            # generate_series has a static fan-out bound (caps.join_out) with
+            # an overflow flag — static shapes, so it fuses like a sized join
+            from ..ops.flat_map import flat_map_materialize
+
+            inp = self._emit(e.input, ctx)
+            out, errs, over = flat_map_materialize(inp, e.exprs, caps.join_out)
+            ctx.errs.append(errs)
+            ctx.overflow.append(over)
+            return out
         if isinstance(e, lir.Join):
             return self._emit_join(e, ctx)
         if isinstance(e, lir.Reduce):
@@ -461,9 +481,12 @@ class FusedCompiler:
         ctx.errs.append(errs)
         contrib = consolidate_accums(raw)
         old_accums, old_nrows, missed = accum_lsm_lookup(lsm, contrib)
-        from ..ops.reduce import collision_errs
+        from ..ops.reduce import accum_overflow_errs, collision_errs
 
         ctx.errs.append(collision_errs(contrib, missed, ctx.time))
+        ov = accum_overflow_errs(contrib, old_accums, e.aggs, ctx.time)
+        if ov is not None:
+            ctx.errs.append(ov)
         out = consolidate(
             _emit_output(contrib, old_accums, old_nrows, ctx.time, e.aggs)
         )

@@ -110,6 +110,29 @@ class MfpNode(Node):
         return out, _union([errs, new_errs])
 
 
+class FlatMapNode(Node):
+    """generate_series fan-out via the two-pass sized kernel (ops/flat_map.py);
+    output capacity follows the count pass (pow2-bucketed)."""
+
+    def __init__(self, expr):
+        self.exprs = tuple(expr.exprs)
+
+    def step(self, tick, ins):
+        from ..ops.flat_map import flat_map_materialize, flat_map_total
+
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        total = int(flat_map_total(oks, self.exprs))
+        out, new_errs, _over = flat_map_materialize(
+            oks, self.exprs, bucket_cap(total)
+        )
+        return out, _union([errs, new_errs])
+
+
 class NegateNode(Node):
     def step(self, tick, ins):
         d = ins[0]
@@ -1072,6 +1095,10 @@ class Dataflow:
             self.has_temporal = True
             ops.append((TemporalFilterNode(e), [ref]))
             return len(ops) - 1
+        if isinstance(e, lir.FlatMap):
+            ref = self._render(e.input, ops)
+            ops.append((FlatMapNode(e), [ref]))
+            return len(ops) - 1
         raise NotImplementedError(f"render: {type(e).__name__}")
 
     def _infer_dtypes(self, expr) -> tuple:
@@ -1123,6 +1150,8 @@ class Dataflow:
             return tuple(e.body_dtypes)
         if isinstance(e, lir.TemporalFilter):
             return self._infer_dtypes(e.input)
+        if isinstance(e, lir.FlatMap):
+            return self._infer_dtypes(e.input) + (np.dtype(np.int64),)
         raise NotImplementedError(f"dtypes: {type(e).__name__}")
 
     # -- execution ---------------------------------------------------------

@@ -127,6 +127,21 @@ class MirWindow:
 
 
 @dataclass(frozen=True)
+class MirFlatMap:
+    """Table function over each input row (reference: MirRelationExpr::FlatMap,
+    src/expr/src/relation/mod.rs; rendered at compute/src/render/flat_map.rs).
+
+    `func` = "generate_series"; `exprs` are (lo, hi, step) scalar exprs over
+    the input row. Output = input columns ++ one series-value column; a row
+    with count k fans out to k rows carrying its diff/time.
+    """
+
+    input: "MirExpr"
+    func: str
+    exprs: tuple = ()
+
+
+@dataclass(frozen=True)
 class MirNegate:
     input: Any
 
@@ -200,13 +215,15 @@ def arity(e: MirExpr) -> int:
         return arity(e.body)
     if isinstance(e, MirTemporalFilter):
         return arity(e.input)
+    if isinstance(e, MirFlatMap):
+        return arity(e.input) + 1
     raise TypeError(f"not a MirExpr: {e!r}")
 
 
 def children(e: MirExpr) -> tuple:
     if isinstance(e, (MirConstant, MirGet)):
         return ()
-    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirWindow, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirWindow, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter, MirFlatMap)):
         return (e.input,)
     if isinstance(e, (MirJoin, MirUnion)):
         return tuple(e.inputs)
@@ -235,7 +252,7 @@ def collect_get_ids(e: MirExpr) -> set:
 def with_children(e: MirExpr, new: tuple) -> MirExpr:
     if isinstance(e, (MirConstant, MirGet)):
         return e
-    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirWindow, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter)):
+    if isinstance(e, (MirMap, MirFilter, MirProject, MirReduce, MirTopK, MirWindow, MirNegate, MirThreshold, MirDistinct, MirTemporalFilter, MirFlatMap)):
         return replace(e, input=new[0])
     if isinstance(e, (MirJoin, MirUnion)):
         return replace(e, inputs=tuple(new))

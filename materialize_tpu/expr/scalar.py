@@ -111,6 +111,7 @@ class EvalErr(enum.IntEnum):
     # string-function tables cannot resolve it
     STRING_CODE_OOB = 4
     NEGATIVE_FUNC_ARG = 5
+    STEP_ZERO = 6  # generate_series step size cannot equal zero
 
 
 @dataclass(frozen=True)

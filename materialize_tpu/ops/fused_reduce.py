@@ -48,11 +48,14 @@ def fused_mfp_reduce_step(
     raw, errs2 = _contributions(oks, key_cols, aggs)
     contrib = consolidate_accums(raw)
     _found, old_accums, old_nrows, missed = lookup_accums(state, contrib)
-    from .reduce import collision_errs
+    from .reduce import accum_overflow_errs, collision_errs
 
     errs2 = consolidate(
         UpdateBatch.concat(errs2, collision_errs(contrib, missed, time))
     )
+    ov = accum_overflow_errs(contrib, old_accums, aggs, time)
+    if ov is not None:
+        errs2 = consolidate(UpdateBatch.concat(errs2, ov))
     out = consolidate(_emit_output(contrib, old_accums, old_nrows, time, aggs))
     new_state = consolidate_accums(AccumState.concat(state, contrib))
     errs = errs2 if errs1 is None else consolidate(UpdateBatch.concat(errs1, errs2))

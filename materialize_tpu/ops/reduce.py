@@ -365,6 +365,46 @@ def lookup_accums(state: AccumState, probe: AccumState):
     return found, accums, nrows, missed
 
 
+# fixed-point float accumulators flag loudly before i64 wrap: 2^60 leaves
+# 8x headroom over any single additional contribution (advisor r4: the
+# engine's error model is loud failure, never silent mis-aggregation; the
+# reference's Accum::Float carries i128 headroom instead)
+_ACCUM_OVERFLOW_BOUND = np.int64(1) << np.int64(60)
+
+
+def accum_overflow_errs(
+    contrib: AccumState, old_accums, aggs: tuple, time
+) -> UpdateBatch | None:
+    """Error rows for fixed-point accumulators near the i64 bound.
+
+    Checks both the tick's contributions and the post-merge totals
+    (old + contribution) of affected keys; returns None without touching
+    the device when no agg is fixed-point (zero cost for integer
+    aggregates)."""
+    scales = tuple(getattr(a, "fixed_scale", 0) for a in aggs)
+    if not any(scales):
+        return None
+    t = jnp.asarray(time, dtype=jnp.uint64)
+    over = contrib.count() < 0  # varying-typed False
+    for (c, o, s) in zip(contrib.accums, old_accums, scales):
+        if not s:
+            continue
+        over = over | (jnp.abs(c) > _ACCUM_OVERFLOW_BOUND) | (
+            jnp.abs(o + c) > _ACCUM_OVERFLOW_BOUND
+        )
+    over = over & contrib.live
+    from ..expr.scalar import EvalErr
+
+    code = jnp.asarray(int(EvalErr.NUMERIC_OVERFLOW), jnp.int64)
+    return UpdateBatch(
+        hashes=jnp.where(over, jnp.zeros_like(contrib.hashes), PAD_HASH),
+        keys=(),
+        vals=(jnp.where(over, code, 0),),
+        times=jnp.where(over, t, PAD_TIME),
+        diffs=jnp.where(over, 1, 0).astype(jnp.int64),
+    )
+
+
 @jax.jit
 def collision_errs(probe: AccumState, missed, time) -> UpdateBatch:
     """Error-collection rows for unresolved hash-bucket probes."""
@@ -458,5 +498,8 @@ def accumulable_step(
     errs = consolidate(
         UpdateBatch.concat(errs, collision_errs(contrib, missed, time))
     )
+    ov = accum_overflow_errs(contrib, old_accums, aggs, time)
+    if ov is not None:
+        errs = consolidate(UpdateBatch.concat(errs, ov))
     new_state = consolidate_accums(AccumState.concat(state, contrib))
     return new_state, out, errs

@@ -82,6 +82,8 @@ class Lowerer:
             return self.dtypes(e.body)
         if isinstance(e, mir.MirTemporalFilter):
             return self.dtypes(e.input)
+        if isinstance(e, mir.MirFlatMap):
+            return self.dtypes(e.input) + (I64,)
         raise TypeError(f"dtypes: {type(e).__name__}")
 
     # -- lowering -------------------------------------------------------------
@@ -209,6 +211,8 @@ class Lowerer:
             return lir.TemporalFilter(
                 self.lower(e.input), tuple(e.lowers), tuple(e.uppers)
             )
+        if isinstance(e, mir.MirFlatMap):
+            return lir.FlatMap(self.lower(e.input), e.func, tuple(e.exprs))
         if isinstance(e, mir.MirLetRec):
             rec_ids = set()
             for gid, dts, _b in e.bindings:

@@ -936,14 +936,28 @@ class Planner:
         factors: list = []
         scopes: list[Scope] = []
         on_preds: list = []
+        outer_fm = getattr(self, "_pending_fm", None)
+        self._pending_fm = []
         if not sel.from_:
             factors.append(mir.MirConstant(rows=(((), 1),), dtypes=()))
             scopes.append(Scope([]))
         for f in sel.from_:
             self._flatten_from(f, factors, scopes, on_preds)
+        pending_fm = self._pending_fm
+        self._pending_fm = outer_fm
+        if pending_fm:
+            # their scope slots must be the trailing ones: the FlatMap output
+            # column is appended after all factor columns
+            want = list(range(len(scopes) - len(pending_fm), len(scopes)))
+            if [i for _n, _a, _al, i in pending_fm] != want:
+                raise PlanError(
+                    "correlated generate_series must come after all plain "
+                    "FROM items"
+                )
         # 1b. lift uncorrelated subqueries (IN / EXISTS / scalar) into join
         # factors — the decorrelation-lite path (reference: HIR→MIR lowering
         # in src/sql/src/plan/lowering.rs; correlated forms are future work)
+        n_factors_pre_lift = len(factors)
         lifter = _SubqueryLifter(self, factors, scopes)
         # WHERE/ON conjuncts may register antijoins (top level only); other
         # contexts reject NOT IN/NOT EXISTS instead of silently misplanning
@@ -981,11 +995,25 @@ class Planner:
         conjuncts.extend(lifter.extra_conjuncts)
         temporal = [c for c in conjuncts if _contains_mz_now(c)]
         conjuncts = [c for c in conjuncts if not _contains_mz_now(c)]
+        if not factors:
+            # every FROM item was a correlated table function: fan out of the
+            # unit relation
+            factors.append(mir.MirConstant(rows=(((), 1),), dtypes=()))
+        if pending_fm and len(factors) > n_factors_pre_lift:
+            # a lifted subquery factor would sit AFTER the FlatMap's scope
+            # slot, misaligning every post-join column index
+            raise PlanError(
+                "correlated generate_series cannot be combined with "
+                "IN/EXISTS/scalar subqueries yet"
+            )
+        flat_start = len(full_scope.cols) - len(pending_fm)
         equivs: list[set] = []
         residual = []
         for c in conjuncts:
             pair = self._as_column_equality(c, full_scope, scopes, offsets)
-            if pair is not None:
+            # equalities touching a FlatMap output column can't join factors
+            # (the column doesn't exist until after the join) — filter instead
+            if pair is not None and all(i < flat_start for i in pair):
                 merged = False
                 for cls in equivs:
                     if pair[0] in cls or pair[1] in cls:
@@ -1004,6 +1032,13 @@ class Planner:
                 equivalences=tuple(tuple(sorted(c)) for c in equivs),
             )
         scope = full_scope
+        # correlated table functions fan out on top of the joined factors
+        for k, (fname, fargs, _alias, _si) in enumerate(pending_fm):
+            prefix = Scope(list(full_scope.cols[: flat_start + k]))
+            planned_args = [self.plan_scalar(a, prefix)[0] for a in fargs]
+            if len(planned_args) == 2:
+                planned_args.append(Literal(1))
+            rel = mir.MirFlatMap(rel, fname, tuple(planned_args))
         for c in residual:
             p, _t = self.plan_scalar(c, scope)
             rel = mir.MirFilter(rel, (p,))
@@ -1209,25 +1244,46 @@ class Planner:
             return
         if isinstance(f, ast.TableFuncRef):
             if f.name == "generate_series":
-                vals = []
-                for a in f.args:
-                    p, _t = self.plan_scalar(a, Scope([]))
-                    if not isinstance(p, Literal):
-                        raise PlanError("generate_series arguments must be literals")
-                    vals.append(int(p.value))
-                if len(vals) == 2:
-                    lo, hi, step = vals[0], vals[1], 1
-                elif len(vals) == 3:
-                    lo, hi, step = vals
-                else:
+                if len(f.args) not in (2, 3):
                     raise PlanError("generate_series takes 2 or 3 arguments")
+                alias = f.alias or "generate_series"
+                try:
+                    vals = []
+                    for a in f.args:
+                        p, _t = self.plan_scalar(a, Scope([]))
+                        if (
+                            isinstance(p, CallUnary)
+                            and p.func == "neg"
+                            and isinstance(p.expr, Literal)
+                        ):
+                            p = Literal(-p.expr.value, p.expr.dtype)
+                        if not isinstance(p, Literal):
+                            raise PlanError("non-literal")
+                        vals.append(int(p.value))
+                except PlanError:
+                    # CORRELATED series (args reference other FROM columns):
+                    # becomes a FlatMap applied on top of the joined factors
+                    # (reference MirRelationExpr::FlatMap, rendered at
+                    # compute/src/render/flat_map.rs). Must trail the plain
+                    # factors so its output column is the last one.
+                    if getattr(self, "_no_flatmaps", False):
+                        raise PlanError(
+                            "correlated generate_series is only supported as "
+                            "a top-level FROM item"
+                        )
+                    self._pending_fm.append(
+                        (f.name, tuple(f.args), alias, len(scopes))
+                    )
+                    scopes.append(Scope([ScopeCol(alias, alias, INT)]))
+                    return
+                lo, hi = vals[0], vals[1]
+                step = vals[2] if len(vals) == 3 else 1
                 if step == 0:
                     raise PlanError("generate_series step must be nonzero")
                 rows = tuple(((v,), 1) for v in range(lo, hi + (1 if step > 0 else -1), step))
                 factors.append(
                     mir.MirConstant(rows=rows, dtypes=(np.dtype(np.int64),))
                 )
-                alias = f.alias or "generate_series"
                 scopes.append(Scope([ScopeCol(alias, alias, INT)]))
                 return
             raise PlanError(f"unsupported table function {f.name}")
@@ -1259,7 +1315,19 @@ class Planner:
         raise PlanError(f"unsupported FROM clause {type(f).__name__}")
 
     def _plan_factor_rel(self, f):
-        """Plan one table factor (incl. nested joins) to a (rel, scope)."""
+        """Plan one table factor (incl. nested joins) to a (rel, scope).
+
+        Correlated table functions are not supported inside nested factor
+        trees (outer joins etc.) — `_no_flatmaps` makes them error cleanly.
+        """
+        prev_guard = getattr(self, "_no_flatmaps", False)
+        self._no_flatmaps = True
+        try:
+            return self._plan_factor_rel_inner(f)
+        finally:
+            self._no_flatmaps = prev_guard
+
+    def _plan_factor_rel_inner(self, f):
         factors: list = []
         scopes: list[Scope] = []
         on_preds: list = []

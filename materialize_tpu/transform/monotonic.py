@@ -30,5 +30,8 @@ def is_monotonic(e, mono_ids: set) -> bool:
     if isinstance(e, mir.MirTemporalFilter):
         # upper bounds schedule retractions; lower-bound-only stays monotonic
         return not e.uppers and is_monotonic(e.input, mono_ids)
+    if isinstance(e, mir.MirFlatMap):
+        # fan-out preserves the sign of diffs
+        return is_monotonic(e.input, mono_ids)
     # Reduce/TopK/Negate/LetRec outputs can retract
     return False

@@ -1,0 +1,62 @@
+"""Regression pins for the r4 advisor findings."""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+
+
+def test_scientific_notation_matches_plain_decimal():
+    """'2.678' and '2.678e0' must encode identically in a NUMERIC column
+    (the sci-notation path used to round via f64 while plain decimals
+    truncate)."""
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int, n numeric)")
+    c.execute("INSERT INTO t VALUES (1, 2.678), (2, 2.678e0), (3, 26.78e-1)")
+    rows = dict(c.execute("SELECT a, n FROM t").rows)
+    assert rows[1] == rows[2] == rows[3]
+    c.execute("CREATE TABLE f (a int, x double)")
+    c.execute("INSERT INTO f VALUES (1, 0.1), (2, 1e-1)")
+    fr = dict(c.execute("SELECT a, x FROM f").rows)
+    assert fr[1] == fr[2] == float(np.float32("0.1"))
+
+
+def test_float_mod_matches_device():
+    """Host fast-path float mod mirrors the f32 device kernel."""
+    c = Coordinator()
+    c.execute("CREATE TABLE t (x double, y double)")
+    c.execute("INSERT INTO t VALUES (7.5, 2.25), (-7.5, 2.25), (7.5, -2.25)")
+    # fast path (host interpreter)
+    fast = sorted(c.execute("SELECT x % y FROM t").rows)
+
+    def f32mod(l, r):
+        lf, rf = np.float32(l), np.float32(r)
+        q = np.float32(np.abs(lf) // np.abs(rf))
+        s = -q if (lf < 0) != (rf < 0) else q
+        return float(np.float32(lf - rf * np.float32(s)))
+
+    want = sorted(
+        [(f32mod(7.5, 2.25),), (f32mod(-7.5, 2.25),), (f32mod(7.5, -2.25),)]
+    )
+    assert fast == want
+
+
+def test_float_sum_overflow_errors_loudly():
+    """A fixed-point float sum near the i64 bound raises on peek instead of
+    silently wrapping (ops/reduce.py accum_overflow_errs)."""
+    c = Coordinator()
+    c.execute("CREATE TABLE t (v double)")
+    c.execute("CREATE MATERIALIZED VIEW s AS SELECT sum(v) FROM t")
+    # |1e12 * 2^24| > 2^60: one contribution already crosses the bound
+    c.execute("INSERT INTO t VALUES (1e12)")
+    with pytest.raises(RuntimeError):
+        c.execute("SELECT * FROM s")
+
+
+def test_reasonable_float_sums_still_work():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (v double)")
+    c.execute("CREATE MATERIALIZED VIEW s AS SELECT sum(v) FROM t")
+    c.execute("INSERT INTO t VALUES (1e9), (2.5), (-1e9)")
+    (row,) = c.execute("SELECT * FROM s").rows
+    assert row[0] == 2.5
