@@ -1765,6 +1765,10 @@ def _eval_scalar_on_row(e, row: list):
             if r == 0:
                 raise PlanError("division by zero")
             return l // r if e.func == "fdiv" else l - r * (l // r)
+        if e.func == "add_months":
+            from ..expr.scalar import add_months_int
+
+            return add_months_int(int(l), int(r))
         return {
             "add": lambda: f32(np.float32(l) + np.float32(r)) if fl else l + r,
             "sub": lambda: f32(np.float32(l) - np.float32(r)) if fl else l - r,

@@ -314,6 +314,15 @@ def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             return jnp.power(lv.astype(jnp.float32), rv.astype(jnp.float32)), null, err
         if f == "atan2":
             return jnp.arctan2(lv.astype(jnp.float32), rv.astype(jnp.float32)), null, err
+        if f == "add_months":
+            # calendar month addition with pg's end-of-month clamp:
+            # Jan 31 + 1 month = Feb 28/29 (reference interval.rs semantics)
+            y, m, d = _civil_from_days(lv)
+            t = y * 12 + (m - 1) + rv.astype(jnp.int64)
+            y2 = t // 12
+            m2 = t % 12 + 1
+            d2 = jnp.minimum(d, _days_in_month(y2, m2))
+            return _days_from_civil(y2, m2, d2), null, err
         if f in ("fdiv", "fmod"):
             # FLOOR division/modulo (internal: date_trunc/extract arithmetic;
             # SQL-visible div/mod truncate toward zero instead)
@@ -479,6 +488,25 @@ _FLOAT_UNARY_NP = {
     "radians": np.radians,
 }
 assert set(_FLOAT_UNARY_NP) == set(_FLOAT_UNARY)
+
+
+_MONTH_DAYS = np.array([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31])
+
+
+def _days_in_month(y, m):
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    base = jnp.asarray(_MONTH_DAYS)[jnp.clip(m - 1, 0, 11)]
+    return base + (leap & (m == 2))
+
+
+def add_months_int(v: int, n: int) -> int:
+    """Host mirror of the device add_months kernel (same clamp rule)."""
+    y, m, d = civil_from_days_int(int(v))
+    t = y * 12 + (m - 1) + int(n)
+    y2, m2 = t // 12, t % 12 + 1
+    leap = (y2 % 4 == 0 and y2 % 100 != 0) or y2 % 400 == 0
+    dim = [31, 29 if leap else 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31][m2 - 1]
+    return days_from_civil_int(y2, m2, min(d, dim))
 
 
 def _days_from_civil(y, m, d):

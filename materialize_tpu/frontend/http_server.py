@@ -62,6 +62,23 @@ class SqlHandler(BaseHTTPRequestHandler):
             return self._reply(200, "ok", "text/plain")
         if self.path == "/metrics":
             return self._reply(200, self._metrics_text(), "text/plain")
+        if self.path.startswith("/prof/cpu"):
+            from urllib.parse import parse_qs, urlparse
+
+            from ..utils.prof import cpu_profile_folded
+
+            seconds = 1.0
+            qs = parse_qs(urlparse(self.path).query)
+            if "seconds" in qs:
+                try:
+                    seconds = min(float(qs["seconds"][0]), 30.0)
+                except ValueError:
+                    pass
+            return self._reply(200, cpu_profile_folded(seconds), "text/plain")
+        if self.path.startswith("/prof/heap"):
+            from ..utils.prof import heap_profile_text
+
+            return self._reply(200, heap_profile_text(), "text/plain")
         if self.path.startswith("/api/subscribe/") and self.path.endswith("/poll"):
             sub_id = self.path.split("/")[3]
             with self.lock:

@@ -47,6 +47,15 @@ class DateLit:
 
 
 @dataclass(frozen=True)
+class IntervalLit:
+    """INTERVAL '<n> year/month/week/day …' (reference: mz-repr Interval,
+    src/repr/src/adt/interval.rs — the DATE-granularity slice: the engine's
+    calendar unit is days, so sub-day fields are rejected at planning)."""
+
+    value: str
+
+
+@dataclass(frozen=True)
 class UnaryOp:
     op: str  # - | not
     expr: Any

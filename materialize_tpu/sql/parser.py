@@ -745,6 +745,12 @@ class Parser:
             self.next()
             lit = self.next()
             return ast.DateLit(lit.value)
+        if self.at_kw("interval"):
+            self.next()
+            lit = self.next()
+            if lit.kind != "STRING":
+                raise ParseError("INTERVAL requires a quoted string")
+            return ast.IntervalLit(lit.value)
         if self.at_kw("cast"):
             self.next()
             self.expect_op("(")

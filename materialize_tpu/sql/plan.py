@@ -148,6 +148,39 @@ def _map_window_spec(spec, fn):
     )
 
 
+def _parse_interval(text: str) -> tuple[int, int]:
+    """'1 year 2 months 3 days' → (months, days). Weeks fold into days;
+    sub-day fields are rejected (the engine's calendar unit is days).
+    The WHOLE string must tokenize — '1.5 months' or '- 3 days' error
+    instead of silently dropping characters."""
+    import re as _re
+
+    if not _re.fullmatch(r"\s*([+-]?\d+\s*[a-zA-Z]+\s*)+", text):
+        raise PlanError(f"cannot parse interval {text!r}")
+    months = days = 0
+    matched = False
+    for num, unit in _re.findall(r"([+-]?\d+)\s*([a-zA-Z]+)", text):
+        n = int(num)
+        u = unit.lower().rstrip("s")
+        matched = True
+        if u in ("year", "yr", "y"):
+            months += 12 * n
+        elif u in ("month", "mon"):
+            months += n
+        elif u in ("week", "w"):
+            days += 7 * n
+        elif u in ("day", "d"):
+            days += n
+        else:
+            raise PlanError(
+                f"interval unit {unit!r} unsupported (DATE granularity: "
+                "year/month/week/day)"
+            )
+    if not matched:
+        raise PlanError(f"cannot parse interval {text!r}")
+    return months, days
+
+
 def _argtype(t: PType):
     """Decode tag for host-side multi-arg string evaluation (expr/strings.py)."""
     if t.col == ColType.STRING:
@@ -334,6 +367,34 @@ class Planner:
 
     def _plan_binary(self, e: ast.BinaryOp, scope: Scope):
         op = e.op
+        # DATE ± INTERVAL (and INTERVAL + DATE): calendar arithmetic planned
+        # structurally — months via the clamping add_months kernel, days as
+        # plain addition (mz-repr Interval, DATE-granularity slice)
+        if op in ("+", "-") and (
+            isinstance(e.right, ast.IntervalLit) or isinstance(e.left, ast.IntervalLit)
+        ):
+            if isinstance(e.left, ast.IntervalLit):
+                if op == "-":
+                    raise PlanError("cannot subtract a date from an interval")
+                date_ast, iv = e.right, e.left
+            else:
+                date_ast, iv = e.left, e.right
+            months, days = _parse_interval(iv.value)
+            if op == "-":
+                months, days = -months, -days
+            v, vt = self.plan_scalar(date_ast, scope)
+            if vt.col != ColType.TIMESTAMP:
+                raise PlanError("interval arithmetic requires a date operand")
+            # pg/Materialize order: months FIRST (with end-of-month clamp),
+            # then days — '1995-03-31' - '1 month 1 day' is Feb 27, not the
+            # day-first Feb 28
+            if months:
+                v = CallBinary("add_months", v, Literal(months))
+            if days:
+                v = CallBinary("add", v, Literal(days))
+            return v, DATE
+        if isinstance(e.left, ast.IntervalLit) or isinstance(e.right, ast.IntervalLit):
+            raise PlanError(f"INTERVAL unsupported with operator {op}")
         if op in ("and", "or"):
             l, _ = self.plan_scalar(e.left, scope)
             r, _ = self.plan_scalar(e.right, scope)

@@ -113,6 +113,108 @@ def predicate_pushdown(e):
     return _map_tree(e, go)
 
 
+def demand(e):
+    """Demand analysis (the reference's Demand transform,
+    src/transform/src/demand.rs): map expressions whose output column no
+    consumer reads are replaced with a dummy literal, so their (possibly
+    expensive — string tables, window math) evaluation is skipped. Arity is
+    preserved (the reference uses the same dummy trick), so no index
+    remapping ripples through parents.
+
+    Propagation is top-down through the column-stable nodes; Join/Reduce/
+    TopK/FlatMap/Window conservatively demand everything below them.
+    """
+    from ..expr.scalar import expr_columns
+
+    def go(n, needed):
+        # needed: set of demanded output columns, or None = all
+        if isinstance(n, mir.MirProject):
+            # a projection narrows demand even at the root (needed=None means
+            # "all MY outputs", which is still only the projected columns)
+            idx = range(len(n.outputs)) if needed is None else needed
+            child_needed = {n.outputs[i] for i in idx if i < len(n.outputs)}
+            return mir.MirProject(go(n.input, child_needed), n.outputs)
+        if isinstance(n, mir.MirMap):
+            base = mir.arity(n.input)
+            nmaps = len(n.exprs)
+            if needed is None:
+                keep = set(range(base + nmaps))
+            else:
+                keep = set(needed)
+            # transitive demand: a kept map's references are demanded too
+            changed = True
+            while changed:
+                changed = False
+                for j in range(nmaps - 1, -1, -1):
+                    if base + j in keep:
+                        for c in expr_columns(n.exprs[j]):
+                            if c not in keep:
+                                keep.add(c)
+                                changed = True
+            new_exprs = tuple(
+                ex if base + j in keep else Literal(0)
+                for j, ex in enumerate(n.exprs)
+            )
+            child_needed = {c for c in keep if c < base}
+            return mir.MirMap(go(n.input, child_needed), new_exprs)
+        if isinstance(n, mir.MirFilter):
+            base = mir.arity(n.input)
+            child_needed = None
+            if needed is not None:
+                child_needed = set(needed)
+                for p in n.predicates:
+                    child_needed |= {c for c in expr_columns(p) if c < base}
+            return mir.MirFilter(go(n.input, child_needed), n.predicates)
+        if isinstance(n, mir.MirUnion):
+            # a dummy changes the column's dtype; union branches must concat
+            # with IDENTICAL dtypes, so no dummies below a union
+            return mir.MirUnion(tuple(go(i, None) for i in n.inputs))
+        if isinstance(n, mir.MirNegate):
+            # sign flip is per-row-linear: merging dummy-equal rows is
+            # observation-equivalent, so demand passes through
+            return replace(n, input=go(n.input, needed))
+        if isinstance(n, mir.MirThreshold):
+            # threshold depends on FULL-row multiplicities: dummying an
+            # unread column could merge rows whose counts must stay separate
+            # (demand.rs likewise demands all columns here)
+            return replace(n, input=go(n.input, None))
+        # everything else (Join, Reduce, TopK, Window, Distinct, FlatMap,
+        # TemporalFilter, LetRec, leaves): demand everything below
+        kids = mir.children(n)
+        if kids:
+            n = mir.with_children(n, tuple(go(k, None) for k in kids))
+        return n
+
+    return go(e, None)
+
+
+def simplify_algebraic(e):
+    """Local algebraic identities (reference: canonicalization transforms):
+    Negate(Negate(x)) → x, Distinct(Distinct(x)) → Distinct(x),
+    Threshold(Threshold(x)) → Threshold(x), Distinct over a Reduce keyed on
+    every output column → the Reduce (its keys are already unique),
+    single-input Union → the input."""
+
+    def go(n):
+        if isinstance(n, mir.MirNegate) and isinstance(n.input, mir.MirNegate):
+            return n.input.input
+        if isinstance(n, mir.MirDistinct) and isinstance(n.input, mir.MirDistinct):
+            return n.input
+        if isinstance(n, mir.MirThreshold) and isinstance(
+            n.input, mir.MirThreshold
+        ):
+            return n.input
+        if isinstance(n, mir.MirDistinct) and isinstance(n.input, mir.MirReduce):
+            r = n.input
+            if not r.aggregates and len(r.group_key) == mir.arity(r):
+                return r
+        if isinstance(n, mir.MirUnion) and len(n.inputs) == 1:
+            return n.inputs[0]
+        return n
+
+    return _map_tree(e, go)
+
+
 def fold_constants(e):
     """Remove always-true literal predicates; empty always-false branches."""
 
@@ -160,6 +262,8 @@ def optimize(e, configs=None):
     e = fuse(e)
     e = predicate_pushdown(e)
     e = fuse(e)
+    e = simplify_algebraic(e)
     e = fold_constants(e)
+    e = demand(e)
     e = attach_join_plans(e, configs)
     return e

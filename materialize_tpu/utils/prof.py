@@ -1,0 +1,79 @@
+"""CPU/heap profiling endpoints — the reference's mz-prof analogue.
+
+The reference serves pprof flamegraphs and jemalloc heap profiles from
+environmentd/clusterd HTTP servers (src/prof/src/http.rs). Here:
+
+- `/prof/cpu?seconds=S` — a py-spy-style SAMPLING profiler: every ~5 ms it
+  snapshots every thread's Python stack (`sys._current_frames`, no tracing
+  overhead on the profiled code) and returns collapsed "folded stack"
+  lines (`a;b;c count`) — the flamegraph.pl / speedscope input format.
+- `/prof/heap` — tracemalloc top allocation sites (started lazily on first
+  request; the text notes the start point since earlier allocations are
+  invisible to it).
+
+Both are plain text, safe to hit in production (bounded duration/size).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+
+def cpu_profile_folded(seconds: float = 1.0, interval: float = 0.005) -> str:
+    """Sample all thread stacks for `seconds`; return folded-stack lines."""
+    me = threading.get_ident()
+    counts: dict[str, int] = {}
+    deadline = time.perf_counter() + max(0.05, seconds)
+    n_samples = 0
+    while time.perf_counter() < deadline:
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue
+            parts = []
+            f = frame
+            while f is not None and len(parts) < 64:
+                code = f.f_code
+                parts.append(f"{code.co_filename.rsplit('/', 1)[-1]}:{code.co_name}")
+                f = f.f_back
+            if parts:
+                key = ";".join(reversed(parts))
+                counts[key] = counts.get(key, 0) + 1
+        n_samples += 1
+        time.sleep(interval)
+    lines = [f"# {n_samples} samples over {seconds}s, {len(counts)} distinct stacks"]
+    for stack, n in sorted(counts.items(), key=lambda kv: -kv[1]):
+        lines.append(f"{stack} {n}")
+    return "\n".join(lines) + "\n"
+
+
+_heap_started_at: float | None = None
+
+
+def heap_profile_text(top: int = 40) -> str:
+    """Top allocation sites since tracemalloc started (lazily, first call)."""
+    import tracemalloc
+
+    global _heap_started_at
+    if not tracemalloc.is_tracing():
+        tracemalloc.start(16)
+        _heap_started_at = time.time()
+        return (
+            "# tracemalloc started now; allocations BEFORE this point are "
+            "invisible — request again after some work\n"
+        )
+    snap = tracemalloc.take_snapshot()
+    stats = snap.statistics("lineno")[:top]
+    total = sum(s.size for s in snap.statistics("filename"))
+    lines = [
+        f"# tracemalloc since {time.strftime('%H:%M:%S', time.localtime(_heap_started_at or 0))}"
+        f", traced total {total / 1e6:.1f} MB, top {len(stats)} sites"
+    ]
+    for s in stats:
+        fr = s.traceback[0]
+        lines.append(
+            f"{fr.filename.rsplit('/', 1)[-1]}:{fr.lineno} "
+            f"{s.size / 1024:.0f} KiB in {s.count} blocks"
+        )
+    return "\n".join(lines) + "\n"

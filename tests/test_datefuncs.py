@@ -46,3 +46,68 @@ def test_extract_in_group_by(coord):
         "SELECT extract(year FROM happened), sum(v) FROM ev GROUP BY extract(year FROM happened) ORDER BY 1"
     )
     assert r.rows == [(1995, 3), (1996, 4)]
+
+
+def test_interval_arithmetic(coord):
+    """DATE ± INTERVAL with pg's end-of-month clamp (mz-repr Interval slice)."""
+    from materialize_tpu.storage.generator import date_num
+
+    def d(y, m, dd):
+        return int(date_num(y, m, dd))
+
+    q = lambda s: coord.execute(s).rows
+    assert q("SELECT DATE '1995-01-31' + INTERVAL '1 month'") == [(d(1995, 2, 28),)]
+    assert q("SELECT DATE '1996-01-31' + INTERVAL '1 month'") == [(d(1996, 2, 29),)]
+    assert q("SELECT DATE '1995-03-17' + INTERVAL '2 weeks'") == [(d(1995, 3, 31),)]
+    assert q("SELECT DATE '1995-03-17' - INTERVAL '1 year 2 months 3 days'") == [
+        (d(1994, 1, 14),)
+    ]
+    assert q("SELECT INTERVAL '3 days' + DATE '1995-03-17'") == [(d(1995, 3, 20),)]
+    # months apply FIRST (with clamp), then days — the pg order
+    assert q("SELECT DATE '1995-03-31' - INTERVAL '1 month 1 day'") == [
+        (d(1995, 2, 27),)
+    ]
+    assert q("SELECT DATE '1995-01-30' + INTERVAL '1 month 1 day'") == [
+        (d(1995, 3, 1),)
+    ]
+    # malformed intervals error instead of silently dropping characters
+    import pytest as _pt
+
+    from materialize_tpu.sql.plan import PlanError
+
+    with _pt.raises(PlanError):
+        q("SELECT DATE '1995-01-01' + INTERVAL '1.5 months'")
+    with _pt.raises(PlanError):
+        q("SELECT DATE '1995-01-01' + INTERVAL '- 3 days'")
+    with _pt.raises(PlanError):
+        q("SELECT DATE '1995-01-01' + INTERVAL '3 hours'")
+
+
+def test_interval_in_maintained_view(coord):
+    coord.execute("CREATE TABLE iv (dt date)")
+    coord.execute("INSERT INTO iv VALUES (DATE '1995-03-01'), (DATE '1995-09-01')")
+    coord.execute(
+        "CREATE MATERIALIZED VIEW mm AS SELECT count(*) FROM iv "
+        "WHERE dt < DATE '1995-01-01' + INTERVAL '6 months'"
+    )
+    assert coord.execute("SELECT * FROM mm").rows == [(1,)]
+    coord.execute("INSERT INTO iv VALUES (DATE '1995-06-30')")
+    assert coord.execute("SELECT * FROM mm").rows == [(2,)]
+
+
+def test_device_host_add_months_agree():
+    import jax.numpy as jnp
+    import numpy as np
+
+    from materialize_tpu.expr.scalar import add_months_int, eval_expr3
+    from materialize_tpu.expr.scalar import CallBinary, Column, Literal
+
+    days = np.array([-400, -31, 0, 30, 58, 1154, 1185, 1520, 10000])
+    for n in (-25, -1, 0, 1, 11, 25):
+        dev, _null, _err = eval_expr3(
+            CallBinary("add_months", Column(0), Literal(n)),
+            [jnp.asarray(days)],
+            len(days),
+        )
+        host = np.array([add_months_int(int(v), n) for v in days])
+        assert (np.asarray(dev) == host).all(), n

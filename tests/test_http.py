@@ -2,6 +2,7 @@
 
 import json
 import threading
+import time
 import urllib.request
 
 import pytest
@@ -83,3 +84,30 @@ def test_readyz_and_metrics(server):
     body, status = get(base, "/metrics")
     assert status == 200
     assert "mzt_catalog_items" in body
+
+
+def test_prof_endpoints(server):
+    """mz-prof analogue: sampling CPU profile (folded stacks) + heap top."""
+    base, coord = server
+    # background work so the sampler has something to see
+    stop = threading.Event()
+
+    def busy():
+        while not stop.is_set():
+            sum(i * i for i in range(2000))
+            time.sleep(0.001)
+
+    t = threading.Thread(target=busy, daemon=True)
+    t.start()
+    body = urllib.request.urlopen(
+        f"{base}/prof/cpu?seconds=0.3", timeout=30
+    ).read().decode()
+    stop.set()
+    assert "samples over" in body
+    assert ";" in body or "distinct stacks" in body
+    h1 = urllib.request.urlopen(f"{base}/prof/heap", timeout=30).read().decode()
+    assert "tracemalloc" in h1
+    coord.execute("CREATE TABLE ph (a int)")
+    coord.execute("INSERT INTO ph VALUES (1), (2)")
+    h2 = urllib.request.urlopen(f"{base}/prof/heap", timeout=30).read().decode()
+    assert "KiB" in h2

@@ -1,0 +1,81 @@
+"""Demand + algebraic simplification passes (VERDICT r4 missing #9 slice).
+
+Reference: Demand (src/transform/src/demand.rs) replaces unread expressions
+with dummies; the canonicalization family handles the algebraic identities.
+"""
+
+import numpy as np
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.expr import relation as mir
+from materialize_tpu.expr.scalar import CallBinary, Column, Literal
+from materialize_tpu.transform.optimize import demand, simplify_algebraic
+
+I64 = np.dtype(np.int64)
+
+
+def _get(n=3):
+    return mir.MirGet("src", n)
+
+
+def test_demand_drops_unread_map_exprs():
+    # map adds two exprs; only the second is projected → first becomes dummy
+    m = mir.MirMap(
+        _get(),
+        (CallBinary("mul", Column(0), Column(1)), CallBinary("add", Column(2), Literal(1))),
+    )
+    p = mir.MirProject(m, (0, 4))
+    out = demand(p)
+    assert isinstance(out, mir.MirProject)
+    exprs = out.input.exprs
+    assert exprs[0] == Literal(0)  # undemanded → dummy
+    assert exprs[1] == CallBinary("add", Column(2), Literal(1))  # kept
+
+
+def test_demand_keeps_transitive_references():
+    # second map reads the first: projecting only the second keeps both
+    m = mir.MirMap(
+        _get(),
+        (CallBinary("mul", Column(0), Column(1)), CallBinary("add", Column(3), Literal(1))),
+    )
+    p = mir.MirProject(m, (4,))
+    out = demand(p)
+    assert out.input.exprs[0] != Literal(0)
+
+
+def test_demand_skips_union_branches():
+    m = mir.MirMap(_get(), (CallBinary("mul", Column(0), Column(0)),))
+    u = mir.MirUnion((m, m))
+    p = mir.MirProject(u, (0,))
+    out = demand(p)
+    for branch in out.input.inputs:
+        assert branch.exprs[0] != Literal(0)  # dtype-stable under unions
+
+
+def test_algebraic_identities():
+    g = _get()
+    assert simplify_algebraic(mir.MirNegate(mir.MirNegate(g))) == g
+    d = mir.MirDistinct(g)
+    assert simplify_algebraic(mir.MirDistinct(d)) == d
+    t = mir.MirThreshold(g)
+    assert simplify_algebraic(mir.MirThreshold(t)) == t
+    r = mir.MirReduce(g, group_key=(0, 1, 2), aggregates=())
+    assert simplify_algebraic(mir.MirDistinct(r)) == r
+    assert simplify_algebraic(mir.MirUnion((g,))) == g
+
+
+def test_end_to_end_results_unchanged():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int, b int)")
+    c.execute("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    # the unread b*b map must not change results (and must not run)
+    c.execute(
+        "CREATE MATERIALIZED VIEW v AS "
+        "SELECT a + 1 AS x FROM (SELECT a, b * b AS unused, a + 1 AS x FROM t) s"
+    )
+    assert sorted(c.execute("SELECT * FROM v").rows) == [(2,), (3,), (4,)]
+    c.execute("INSERT INTO t VALUES (4, 40)")
+    assert sorted(c.execute("SELECT * FROM v").rows) == [(2,), (3,), (4,), (5,)]
+    assert sorted(
+        c.execute("SELECT DISTINCT x FROM (SELECT DISTINCT a AS x FROM t) q").rows
+    ) == [(1,), (2,), (3,), (4,)]
