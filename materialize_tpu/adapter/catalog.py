@@ -36,6 +36,8 @@ _TYPE_MAP = {
     "date": ColType.TIMESTAMP,
     "timestamp": ColType.TIMESTAMP,
     "timestamptz": ColType.TIMESTAMP,
+    "jsonb": ColType.JSONB,
+    "json": ColType.JSONB,
     "timestamp with time zone": ColType.TIMESTAMP,
 }
 

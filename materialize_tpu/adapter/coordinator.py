@@ -701,6 +701,8 @@ class Coordinator:
                 return self._literal_value(ast.NullLit(), cdesc)
             if cdesc.typ == ColType.STRING:
                 return self.catalog.dict.encode(v)
+            if cdesc.typ == ColType.JSONB:
+                return self.catalog.dict.encode(self._json_canonical(v))
             if cdesc.typ == ColType.BOOL:
                 return v.lower() in ("t", "true", "1")
             import re as _re
@@ -744,6 +746,8 @@ class Coordinator:
                 return float(np.float32(e.value))
             return int(e.value)
         if isinstance(e, ast.StringLit):
+            if cdesc.typ == ColType.JSONB:
+                return self.catalog.dict.encode(self._json_canonical(e.value))
             return self.catalog.dict.encode(e.value)
         if isinstance(e, ast.BoolLit):
             return e.value
@@ -756,6 +760,14 @@ class Coordinator:
             y, m, d = (int(x) for x in e.value.split("-"))
             return int(date_num(y, m, d))
         raise PlanError(f"unsupported literal {e!r}")
+
+    def _json_canonical(self, text: str) -> str:
+        from ..expr.strings import json_canonical
+
+        try:
+            return json_canonical(text)
+        except ValueError as exc:
+            raise PlanError(f"invalid input syntax for type jsonb: {exc}") from exc
 
     # -- durability ------------------------------------------------------------
     def _shard(self, gid: str):
@@ -1557,7 +1569,7 @@ class Coordinator:
             t = c.typ
             if is_null_value(v, t.col):
                 out.append(None)
-            elif t.col == ColType.STRING:
+            elif t.col in (ColType.STRING, ColType.JSONB):
                 out.append(self.catalog.dict.decode(int(v)))
             elif t.col == ColType.NUMERIC and t.scale:
                 out.append(v / (10**t.scale))
@@ -1837,6 +1849,8 @@ def _eval_scalar_on_row(e, row: list):
             return None
         args = [e.tables._decode_arg(at, v) for at, v in zip(e.argtypes, vs)]
         r = e.tables.eval_one(e.spec, args)
+        if r is None:
+            return None
         if e.out == "string":
             return e.tables.dct.encode(r)
         if e.out == "bool":

@@ -421,6 +421,24 @@ class BasicAggNode(Node):
         if self.func == "string_agg":
             # string_agg skips NULL inputs; an all-NULL group is NULL
             return self.delim.join(live) if live else None
+        if self.func == "jsonb_agg":
+            import json as _json
+
+            at = self.argtype
+
+            def as_json(r):
+                if at == "jsonb":
+                    return _json.loads(r)
+                if at == "int" or (isinstance(at, tuple) and at[0] == "numeric"):
+                    return float(r) if "." in r else int(r)
+                if at == "float":
+                    return float(r)
+                if at == "bool":
+                    return r == "t"
+                return r  # strings stay JSON strings
+
+            elements = [as_json(r) for r in live] + [None] * nulls
+            return _json.dumps(elements, separators=(",", ":"))
         # array_agg / list_agg keep NULL elements (pg semantics), NULLs last
 
         def q(s: str) -> str:

@@ -430,6 +430,11 @@ def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
             )
         if expr.out == "bool":
             out = out.astype(jnp.int8)
+        else:
+            # table entries can hold the NULL sentinel (json key misses,
+            # bad casts): fold them into the null mask so 3VL holds for
+            # direct consumers of this expression
+            null = null | (out == NULL_I64)
         return out, null, err
     raise TypeError(f"not a ScalarExpr: {expr!r}")
 

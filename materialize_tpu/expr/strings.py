@@ -66,7 +66,52 @@ _OUT = {
     "str_lte": "bool",
     "str_gt": "bool",
     "str_gte": "bool",
+    # jsonb operators over canonical JSON text (repr/types.py ColType.JSONB):
+    # json_get = `->` (jsonb result), json_get_text = `->>` (text result);
+    # missing keys / type mismatches yield SQL NULL (pg semantics)
+    "json_get": "str",
+    "json_get_text": "str",
+    "jsonb_typeof": "str",
+    "jsonb_parse": "str",
+    "jsonb_quote": "str",
+    "jsonb_array_length": "int",
 }
+
+
+def json_canonical(text: str) -> str:
+    """Canonical jsonb text: sorted keys, compact separators — equality of
+    canonical text == jsonb equality (the dictionary-code equality rule)."""
+    import json as _json
+
+    return _json.dumps(
+        _json.loads(text), sort_keys=True, separators=(",", ":")
+    )
+
+
+def _json_navigate(s: str, key, as_text: bool):
+    import json as _json
+
+    try:
+        v = _json.loads(s)
+    except ValueError:
+        return None
+    if isinstance(key, int):
+        if not isinstance(v, list) or not (-len(v) <= key < len(v)):
+            return None
+        r = v[key]
+    else:
+        if not isinstance(v, dict) or key not in v:
+            return None
+        r = v[key]
+    if as_text:
+        if r is None:
+            return None
+        if isinstance(r, bool):
+            return "true" if r else "false"
+        if isinstance(r, (dict, list)):
+            return _json.dumps(r, sort_keys=True, separators=(",", ":"))
+        return str(r)
+    return _json.dumps(r, sort_keys=True, separators=(",", ":"))
 
 
 def out_kind(spec: tuple) -> str:
@@ -192,6 +237,38 @@ def str_func_one(spec: tuple, s: str):
         return s.startswith(spec[1])
     if f == "ends_with":
         return s.endswith(spec[1])
+    if f in ("json_get", "json_get_text"):
+        return _json_navigate(s, spec[1], f == "json_get_text")
+    if f == "jsonb_typeof":
+        import json as _json
+
+        try:
+            v = _json.loads(s)
+        except ValueError:
+            return None
+        return {
+            type(None): "null", bool: "boolean", int: "number",
+            float: "number", str: "string", list: "array", dict: "object",
+        }[type(v)]
+    if f == "jsonb_parse":
+        # cast text → jsonb; invalid JSON yields SQL NULL (divergence: pg
+        # errors — the engine's table path has no per-row error channel)
+        try:
+            return json_canonical(s)
+        except ValueError:
+            return None
+    if f == "jsonb_quote":
+        import json as _json
+
+        return _json.dumps(s)
+    if f == "jsonb_array_length":
+        import json as _json
+
+        try:
+            v = _json.loads(s)
+        except ValueError:
+            return None
+        return len(v) if isinstance(v, list) else None
     raise NotImplementedError(f"string func {spec!r}")
 
 
@@ -214,9 +291,13 @@ class StringFuncTables:
             # dictionary, and those new strings get entries on a later call
             src = list(self.dct._strs[start:n])
             vals = []
+            from .scalar import NULL_I64
+
             for s in src:
                 r = str_func_one(spec, s)
-                if kind == "str":
+                if r is None:  # SQL NULL result (json misses, bad casts)
+                    vals.append(int(NULL_I64) if kind != "bool" else 0)
+                elif kind == "str":
                     vals.append(self.dct.encode(r))
                 elif kind == "bool":
                     vals.append(1 if r else 0)
@@ -290,11 +371,15 @@ class StringFuncTables:
             return out, oob
         stacked = np.stack([np.asarray(c)[todo] for c in cols], axis=1)
         combos, inv = np.unique(stacked, axis=0, return_inverse=True)
+        from .scalar import NULL_I64
+
         results = np.zeros((len(combos),), dtype=dt)
         for j, combo in enumerate(combos):
             args = [self._decode_arg(at, v) for at, v in zip(argtypes, combo)]
             r = self.eval_one(spec, args)
-            if kind == "str":
+            if r is None:
+                results[j] = NULL_I64 if kind != "bool" else 0
+            elif kind == "str":
                 results[j] = self.dct.encode(r)
             elif kind == "bool":
                 results[j] = 1 if r else 0
@@ -321,7 +406,7 @@ def decode_storage_value(argtype, v, dct, bool_style: str = "word"):
         if scale:
             return f"{sign}{iv // 10**scale}.{iv % 10**scale:0{scale}d}"
         return f"{sign}{iv}"
-    if argtype == "str":
+    if argtype in ("str", "jsonb"):  # jsonb stores canonical text codes
         return dct.decode(int(v))
     if argtype == "bool":
         if bool_style == "tf":

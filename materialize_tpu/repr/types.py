@@ -36,6 +36,11 @@ class ColType(enum.Enum):
     STRING = "string"  # dictionary code (i64)
     TIMESTAMP = "timestamp"  # ms since epoch (i64), like mz Timestamp
     NUMERIC = "numeric"  # fixed-point i64, scale in ColumnDesc
+    # canonicalized JSON text (sorted keys, compact separators) interned in
+    # the dictionary: code equality == jsonb equality, so grouping/joins/
+    # DISTINCT work on device; operators evaluate via string-function tables
+    # (reference: src/repr/src/adt/jsonb.rs)
+    JSONB = "jsonb"
 
     @property
     def dtype(self) -> np.dtype:
@@ -54,6 +59,7 @@ _DTYPES = {
     ColType.STRING: np.dtype(np.int64),
     ColType.TIMESTAMP: np.dtype(np.int64),
     ColType.NUMERIC: np.dtype(np.int64),
+    ColType.JSONB: np.dtype(np.int64),
 }
 
 

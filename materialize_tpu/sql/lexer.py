@@ -32,7 +32,7 @@ KEYWORDS = {
     "over", "partition",
 }
 
-_TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||", "::"}
+_TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||", "::", "->"}
 
 
 def lex(sql: str) -> list[Token]:
@@ -113,6 +113,10 @@ def lex(sql: str) -> list[Token]:
                 j += 1
             toks.append(Token("PARAM", sql[i + 1 : j], i))
             i = j
+            continue
+        if sql[i : i + 3] == "->>":
+            toks.append(Token("OP", "->>", i))
+            i += 3
             continue
         two = sql[i : i + 2]
         if two in _TWO_CHAR_OPS:

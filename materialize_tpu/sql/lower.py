@@ -60,7 +60,7 @@ class Lowerer:
                 if a.func == "count":
                     out.append(I64)
                 elif a.func in ("string_agg", "array_agg", "list_agg",
-                                "min_str", "max_str"):
+                                "jsonb_agg", "min_str", "max_str"):
                     out.append(I64)  # rendered string code
                 else:
                     out.append(_expr_np_dtype(a.expr, list(base)))
@@ -261,7 +261,7 @@ class Lowerer:
         defaults = tuple(
             null_sentinel(dt)
             if a.func in ("min", "max", "string_agg", "array_agg", "list_agg",
-                          "min_str", "max_str")
+                          "jsonb_agg", "min_str", "max_str")
             else (0 if np.issubdtype(dt, np.integer) else np.float32(0.0))
             for a, dt in zip(e.aggregates, out_dtypes)
         )
@@ -299,7 +299,10 @@ class Lowerer:
             return lir.Reduce(self.lower(e.input), key_cols=key, distinct=True)
 
         parts = []  # (agg_indices, lir builder fn)
-        _BASIC = ("string_agg", "array_agg", "list_agg", "min_str", "max_str")
+        _BASIC = (
+            "string_agg", "array_agg", "list_agg", "jsonb_agg",
+            "min_str", "max_str",
+        )
         acc_idx = [i for i, a in enumerate(e.aggregates) if a.func in ("sum", "count")]
         hier_idx = [i for i, a in enumerate(e.aggregates) if a.func in ("min", "max")]
         basic_idx = [i for i, a in enumerate(e.aggregates) if a.func in _BASIC]

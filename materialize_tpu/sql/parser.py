@@ -702,10 +702,15 @@ class Parser:
 
     def parse_cast_suffix(self):
         e = self.parse_primary()
-        while self.at_op("::"):
-            self.next()
-            e = ast.Cast(e, self.parse_type_name())
-        return e
+        while True:
+            if self.at_op("::"):
+                self.next()
+                e = ast.Cast(e, self.parse_type_name())
+            elif self.at_op("->") or self.at_op("->>"):
+                op = self.next().value
+                e = ast.BinaryOp(op, e, self.parse_primary())
+            else:
+                return e
 
     def parse_case(self):
         self.expect_kw("case")

@@ -47,6 +47,7 @@ BOOL = PType(ColType.BOOL)
 STRING = PType(ColType.STRING)
 FLOAT = PType(ColType.FLOAT64)
 DATE = PType(ColType.TIMESTAMP)
+JSONB = PType(ColType.JSONB)
 
 
 @dataclass(frozen=True)
@@ -111,9 +112,9 @@ _AGG_FUNCS = {
     "sum", "count", "min", "max", "avg",
     "stddev", "stddev_samp", "stddev_pop", "variance", "var_samp", "var_pop",
     "bool_and", "bool_or",
-    "string_agg", "array_agg", "list_agg",
+    "string_agg", "array_agg", "list_agg", "jsonb_agg",
 }
-_BASIC_AGGS = {"string_agg", "array_agg", "list_agg"}
+_BASIC_AGGS = {"string_agg", "array_agg", "list_agg", "jsonb_agg"}
 
 
 @dataclass(frozen=True)
@@ -185,6 +186,8 @@ def _argtype(t: PType):
     """Decode tag for host-side multi-arg string evaluation (expr/strings.py)."""
     if t.col == ColType.STRING:
         return "str"
+    if t.col == ColType.JSONB:
+        return "jsonb"
     if t.col == ColType.NUMERIC:
         return ("numeric", t.scale)
     if t.col == ColType.FLOAT64:
@@ -402,6 +405,11 @@ class Planner:
         l, lt = self.plan_scalar(e.left, scope)
         r, rt = self.plan_scalar(e.right, scope)
         if op in ("=", "<>", "<", "<=", ">", ">="):
+            if op not in ("=", "<>") and ColType.JSONB in (lt.col, rt.col):
+                raise PlanError(
+                    "jsonb ordering comparisons are not supported "
+                    "(equality and grouping are)"
+                )
             if (
                 op not in ("=", "<>")
                 and ColType.STRING in (lt.col, rt.col)
@@ -443,6 +451,29 @@ class Planner:
             return CallBinary("div", l, r), INT
         if op == "%":
             return CallBinary("mod", l, r), INT
+        if op in ("->", "->>"):
+            if lt.col != ColType.JSONB:
+                raise PlanError(f"{op} requires a jsonb left operand")
+            as_text = op == "->>"
+            out_t = STRING if as_text else JSONB
+            fname = "json_get_text" if as_text else "json_get"
+            if (
+                isinstance(r, CallUnary)
+                and r.func == "neg"
+                and isinstance(r.expr, Literal)
+            ):
+                r = Literal(-r.expr.value, r.expr.dtype)  # j -> -1 (from end)
+            if isinstance(r, Literal) and r.value is not None:
+                key = (
+                    self.catalog.dict.decode(r.value)
+                    if rt.col == ColType.STRING
+                    else int(r.value)
+                )
+                return (
+                    self._dictfunc((fname, key), (l,), ("str",), "string"),
+                    out_t,
+                )
+            raise PlanError(f"{op} key must be a literal string or integer")
         if op in ("like", "not_like", "ilike", "not_ilike"):
             if lt.col != ColType.STRING:
                 raise PlanError("LIKE requires a string operand")
@@ -538,6 +569,19 @@ class Planner:
 
         v, vt = self.plan_scalar(e.expr, scope)
         target = coltype_of(e.typ)
+        if target == ColType.JSONB:
+            if vt.col == ColType.JSONB:
+                return v, JSONB
+            if vt.col == ColType.STRING:
+                # text → jsonb: parse + canonicalize (invalid JSON → NULL,
+                # documented divergence from pg's error)
+                return (
+                    self._dictfunc(("jsonb_parse",), (v,), ("str",), "string"),
+                    JSONB,
+                )
+            raise PlanError("cast to jsonb supports text input")
+        if vt.col == ColType.JSONB and target == ColType.STRING:
+            return v, STRING  # canonical text IS the value
         if target == ColType.NUMERIC:
             scale = 2
             if vt.col == ColType.NUMERIC:
@@ -835,6 +879,35 @@ class Planner:
             need(1)
             v, _t = plan(0)
             return CallUnary("extract_epoch_date", v), INT
+
+        # -- jsonb ------------------------------------------------------------
+        if name == "jsonb_typeof":
+            need(1)
+            v, t = plan(0)
+            if t.col != ColType.JSONB:
+                raise PlanError("jsonb_typeof requires a jsonb argument")
+            return self._dictfunc(("jsonb_typeof",), (v,), ("str",), "string"), STRING
+        if name == "jsonb_array_length":
+            need(1)
+            v, t = plan(0)
+            if t.col != ColType.JSONB:
+                raise PlanError("jsonb_array_length requires a jsonb argument")
+            return (
+                self._dictfunc(("jsonb_array_length",), (v,), ("str",), "int64"),
+                INT,
+            )
+        if name == "to_jsonb":
+            need(1)
+            v, t = plan(0)
+            if t.col == ColType.JSONB:
+                return v, JSONB
+            if t.col == ColType.STRING:
+                # a string becomes a JSON string value (quoted/escaped)
+                return (
+                    self._dictfunc(("jsonb_quote",), (v,), ("str",), "string"),
+                    JSONB,
+                )
+            raise PlanError("to_jsonb supports jsonb/text arguments")
         raise PlanError(f"unsupported function: {name}")
 
     # -- relation planning ---------------------------------------------------
@@ -1674,7 +1747,7 @@ class Planner:
             part_cols = tuple(range(cur, cur + npart))
             for o in spec.order_by:
                 oe, ot = self.plan_scalar(o.expr, scope)
-                if ot.col == ColType.STRING:
+                if ot.col in (ColType.STRING, ColType.JSONB):
                     # the window kernel ranks on device by dictionary code
                     # (insertion order) — reject rather than mis-order
                     raise PlanError(
@@ -1737,9 +1810,11 @@ class Planner:
                     pending.append((wi, "col", (k0 + len(funcs) - 1, vt)))
                 elif name in ("first_value", "last_value", "sum", "min", "max", "count"):
                     acol, vt = arg_col(call.args[0])
-                    if name in ("min", "max") and vt.col == ColType.STRING:
+                    if name in ("min", "max") and vt.col in (
+                        ColType.STRING, ColType.JSONB
+                    ):
                         raise PlanError(
-                            f"window {name} over a string column is not "
+                            f"window {name} over a string/jsonb column is not "
                             "supported (device ordering is by dictionary code)"
                         )
                     out_t = INT if name == "count" else vt
@@ -1926,9 +2001,10 @@ class Planner:
                         raise PlanError("string_agg delimiter must be a string literal")
                     delim = self.catalog.dict.decode(d.value)
                 extra = (delim, _argtype(vt), self.catalog.dict)
+                out_t = JSONB if fname == "jsonb_agg" else STRING
                 i = emit(0, mir.MirAggregate(fname, v, extra=extra))
-                post_agg_exprs.append(("col", i, STRING))
-                agg_types.append(STRING)
+                post_agg_exprs.append(("col", i, out_t))
+                agg_types.append(out_t)
             elif fname in ("bool_and", "bool_or"):
                 # all/any over non-NULL inputs = min/max over the stored
                 # int8 truth values (func.rs All/Any accumulation)
@@ -1939,6 +2015,11 @@ class Planner:
             else:
                 v, vt = self.plan_scalar(a.args[0], scope)
                 out_t = vt if fname != "count" else INT
+                if fname in ("min", "max") and vt.col == ColType.JSONB:
+                    raise PlanError(
+                        f"{fname} over jsonb is not supported (jsonb has no "
+                        "device ordering)"
+                    )
                 if fname in ("min", "max") and vt.col == ColType.STRING:
                     # device top-1 would rank by dictionary code; route
                     # through the Basic class, which compares decoded strings
@@ -2393,7 +2474,7 @@ def _apply_finishing_as_topk(pq: PlannedQuery):
     (coordinator._finish)."""
     if pq.finishing.limit is not None or pq.finishing.offset:
         for col, _desc in pq.finishing.order_by:
-            if pq.scope.cols[col].typ.col == ColType.STRING:
+            if pq.scope.cols[col].typ.col in (ColType.STRING, ColType.JSONB):
                 raise PlanError(
                     "ORDER BY on a string column with LIMIT is not supported "
                     "in maintained views (device ordering is by dictionary "

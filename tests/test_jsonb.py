@@ -1,0 +1,103 @@
+"""jsonb: canonicalized JSON text as a first-class column type.
+
+VERDICT r4 missing #3 slice (reference: src/repr/src/adt/jsonb.rs). Values
+intern as canonical text (sorted keys, compact) so dictionary-code equality
+IS jsonb equality — grouping/joins/DISTINCT work on device; the operators
+(->, ->>, jsonb_typeof, jsonb_array_length, casts, jsonb_agg) evaluate via
+the string-function table machinery.
+"""
+
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.sql.plan import PlanError
+
+
+@pytest.fixture()
+def coord():
+    c = Coordinator()
+    c.execute("CREATE TABLE docs (id int, j jsonb)")
+    c.execute(
+        "INSERT INTO docs VALUES "
+        "(1, '{\"b\": 2, \"a\": {\"x\": [1, 2, 3]}}'), "
+        "(2, '{\"a\": {\"x\": []}, \"c\": true}'), "
+        "(3, NULL)"
+    )
+    return c
+
+
+def q(c, sql):
+    return sorted(c.execute(sql).rows, key=str)
+
+
+def test_canonical_storage(coord):
+    # key order normalizes; equal documents share one code
+    assert q(coord, "SELECT j FROM docs WHERE id = 1") == [
+        ('{"a":{"x":[1,2,3]},"b":2}',)
+    ]
+    coord.execute("INSERT INTO docs VALUES (9, '{\"a\": {\"x\": [1,2,3]}, \"b\": 2}')")
+    assert q(coord, "SELECT count(*) FROM docs GROUP BY j HAVING count(*) > 1") == [
+        (2,)
+    ]
+
+
+def test_field_access_chain(coord):
+    assert q(coord, "SELECT id, j -> 'a' FROM docs") == [
+        (1, '{"x":[1,2,3]}'),
+        (2, '{"x":[]}'),
+        (3, None),
+    ]
+    # -> chains; array index via ->> returns text; misses are NULL
+    assert q(coord, "SELECT id, j -> 'a' -> 'x' ->> 0 FROM docs") == [
+        (1, "1"),
+        (2, None),
+        (3, None),
+    ]
+    assert q(coord, "SELECT id FROM docs WHERE j ->> 'c' = 'true'") == [(2,)]
+
+
+def test_typeof_and_array_length(coord):
+    assert q(coord, "SELECT id, jsonb_typeof(j -> 'a') FROM docs") == [
+        (1, "object"),
+        (2, "object"),
+        (3, None),
+    ]
+    assert q(coord, "SELECT id, jsonb_array_length(j -> 'a' -> 'x') FROM docs") == [
+        (1, 3),
+        (2, 0),
+        (3, None),
+    ]
+
+
+def test_casts(coord):
+    assert coord.execute("SELECT '{\"z\": 1, \"y\":2}'::jsonb").rows == [
+        ('{"y":2,"z":1}',)
+    ]
+    # invalid JSON → NULL (documented divergence: pg errors)
+    assert coord.execute("SELECT 'nope{'::jsonb").rows == [(None,)]
+    assert coord.execute("SELECT to_jsonb('hi')").rows == [('"hi"',)]
+
+
+def test_grouping_on_jsonb(coord):
+    assert q(coord, "SELECT j -> 'a', count(*) FROM docs GROUP BY j -> 'a'") == [
+        ('{"x":[1,2,3]}', 1),
+        ('{"x":[]}', 1),
+        (None, 1),
+    ]
+
+
+def test_ordering_comparisons_rejected(coord):
+    with pytest.raises(PlanError):
+        coord.execute("SELECT id FROM docs WHERE j > j")
+
+
+def test_jsonb_agg_incremental(coord):
+    coord.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT jsonb_agg(j -> 'b') AS a "
+        "FROM docs WHERE j IS NOT NULL"
+    )
+    assert coord.execute("SELECT * FROM mv").rows == [("[2,null]",)]
+    coord.execute("INSERT INTO docs VALUES (4, '{\"b\": 7}')")
+    assert coord.execute("SELECT * FROM mv").rows == [("[2,7,null]",)]
+    coord.execute("DELETE FROM docs WHERE id = 1")
+    assert coord.execute("SELECT * FROM mv").rows == [("[7,null]",)]
