@@ -1376,6 +1376,12 @@ class Coordinator:
             return null_sentinel(cdesc.dtype)
         if cdesc.typ == ColType.STRING:
             return self.catalog.dict.encode(str(v))
+        if cdesc.typ == ColType.JSONB:
+            import json as _json
+
+            # sources deliver either parsed JSON (json format) or text
+            text = v if isinstance(v, str) else _json.dumps(v)
+            return self.catalog.dict.encode(self._json_canonical(text))
         if cdesc.typ == ColType.BOOL:
             if isinstance(v, str):
                 return 1 if v.lower() in ("t", "true", "1") else 0

@@ -403,9 +403,10 @@ class BasicAggNode(Node):
                 nulls += cnt
             else:
                 rendered = self._decode_el(el)
-                # order by VALUE (strings lexicographic, numbers numeric),
-                # not by rendered text — '9' must precede '10'
-                sk = rendered if self.argtype == "str" else el
+                # order by VALUE (strings/jsonb by canonical text, numbers
+                # numeric), never by dictionary code — codes are insertion-
+                # ordered and vary across interning histories
+                sk = rendered if self.argtype in ("str", "jsonb") else el
                 distinct.append((sk, rendered, cnt))
         if self.func in ("min_str", "max_str"):
             # min/max over decoded strings (device top-1 would rank by

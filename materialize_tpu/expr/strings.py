@@ -364,7 +364,7 @@ class StringFuncTables:
         nulls = np.asarray(nulls)
         ndict = len(self.dct)
         for at, c in zip(argtypes, cols):
-            if at == "str":
+            if at in ("str", "jsonb"):
                 oob |= ~nulls & ((np.asarray(c) < 0) | (np.asarray(c) >= ndict))
         todo = ~nulls & ~oob
         if not todo.any():

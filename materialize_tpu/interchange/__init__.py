@@ -1,0 +1,11 @@
+"""Decode/encode external data formats into engine rows.
+
+The analogue of the reference's mz-interchange crate
+(src/interchange/src/{avro,protobuf,csv,json}.rs). csv/json live inline in
+the file source (text formats); this package holds the binary codecs:
+
+- `avro`: schema-driven Avro binary + object container files (OCF)
+- `protobuf`: wire-format decoding against a lightweight field descriptor
+"""
+
+from . import avro, protobuf  # noqa: F401

@@ -1,0 +1,325 @@
+"""Avro binary codec + object container file (OCF) reader/writer.
+
+The analogue of the reference's mz-avro + interchange/avro decoding
+(src/interchange/src/avro.rs; the reference vendors a full Avro
+implementation in src/avro). Implemented from the Avro 1.11 spec — no
+external library. Supported schema: null, boolean, int, long, float,
+double, string, bytes, enum, array, map, records, and unions (decoded by
+branch index; ["null", T] is the SQL-nullable column shape).
+
+OCF files are tailable: each block is (record count, byte length, payload,
+16-byte sync marker), so an ingestion offset can advance block-by-block the
+same way the line tailer advances on '\n' (storage/file_source.py) — a
+partial trailing block stays for the next poll.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import struct
+
+
+# -- varint / zigzag ---------------------------------------------------------
+
+
+def _zigzag_encode(n: int) -> int:
+    return (n << 1) ^ (n >> 63)
+
+
+def _zigzag_decode(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def write_long(buf: io.BytesIO, n: int) -> None:
+    z = _zigzag_encode(n) & 0xFFFFFFFFFFFFFFFF
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def read_long(buf) -> int:
+    shift = 0
+    acc = 0
+    while True:
+        raw = buf.read(1)
+        if not raw:
+            raise EOFError("truncated varint")
+        b = raw[0]
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return _zigzag_decode(acc)
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+# -- schema-driven values ----------------------------------------------------
+
+
+def decode_value(schema, buf):
+    """One datum per `schema` (parsed JSON: str primitive or dict/list)."""
+    if isinstance(schema, str):
+        t = schema
+    elif isinstance(schema, list):  # union: branch index then value
+        idx = read_long(buf)
+        if not (0 <= idx < len(schema)):
+            raise ValueError(f"bad union branch {idx}")
+        return decode_value(schema[idx], buf)
+    else:
+        t = schema["type"]
+    if t == "null":
+        return None
+    if t == "boolean":
+        b = buf.read(1)
+        if not b:
+            raise EOFError("truncated boolean")
+        return b[0] != 0
+    if t in ("int", "long"):
+        return read_long(buf)
+    if t == "float":
+        return struct.unpack("<f", buf.read(4))[0]
+    if t == "double":
+        return struct.unpack("<d", buf.read(8))[0]
+    if t in ("bytes", "string"):
+        n = read_long(buf)
+        raw = buf.read(n)
+        if len(raw) != n:
+            raise EOFError("truncated bytes/string")
+        return raw.decode() if t == "string" else raw
+    if t == "enum":
+        i = read_long(buf)
+        syms = schema["symbols"]
+        if not (0 <= i < len(syms)):
+            raise ValueError(f"bad enum index {i}")
+        return syms[i]
+    if t == "array":
+        out = []
+        while True:
+            n = read_long(buf)
+            if n == 0:
+                return out
+            if n < 0:  # negative count: a byte size follows (skippable form)
+                read_long(buf)
+                n = -n
+            for _ in range(n):
+                out.append(decode_value(schema["items"], buf))
+    if t == "map":
+        out = {}
+        while True:
+            n = read_long(buf)
+            if n == 0:
+                return out
+            if n < 0:
+                read_long(buf)
+                n = -n
+            for _ in range(n):
+                k = decode_value("string", buf)
+                out[k] = decode_value(schema["values"], buf)
+    if t == "record":
+        return {
+            f["name"]: decode_value(f["type"], buf) for f in schema["fields"]
+        }
+    raise ValueError(f"unsupported avro type {t!r}")
+
+
+def encode_value(schema, value, buf: io.BytesIO) -> None:
+    if isinstance(schema, list):  # union: pick the first matching branch
+        for i, branch in enumerate(schema):
+            if _matches(branch, value):
+                write_long(buf, i)
+                return encode_value(branch, value, buf)
+        raise ValueError(f"value {value!r} matches no union branch")
+    t = schema if isinstance(schema, str) else schema["type"]
+    if t == "null":
+        return
+    if t == "boolean":
+        buf.write(b"\x01" if value else b"\x00")
+        return
+    if t in ("int", "long"):
+        write_long(buf, int(value))
+        return
+    if t == "float":
+        buf.write(struct.pack("<f", float(value)))
+        return
+    if t == "double":
+        buf.write(struct.pack("<d", float(value)))
+        return
+    if t in ("bytes", "string"):
+        raw = value.encode() if isinstance(value, str) else bytes(value)
+        write_long(buf, len(raw))
+        buf.write(raw)
+        return
+    if t == "enum":
+        write_long(buf, schema["symbols"].index(value))
+        return
+    if t == "array":
+        if value:
+            write_long(buf, len(value))
+            for v in value:
+                encode_value(schema["items"], v, buf)
+        write_long(buf, 0)
+        return
+    if t == "map":
+        if value:
+            write_long(buf, len(value))
+            for k, v in value.items():
+                encode_value("string", k, buf)
+                encode_value(schema["values"], v, buf)
+        write_long(buf, 0)
+        return
+    if t == "record":
+        for f in schema["fields"]:
+            encode_value(f["type"], value.get(f["name"]), buf)
+        return
+    raise ValueError(f"unsupported avro type {t!r}")
+
+
+def _matches(branch, value) -> bool:
+    t = branch if isinstance(branch, str) else branch["type"]
+    if t == "null":
+        return value is None
+    if t == "boolean":
+        return isinstance(value, bool)
+    if t in ("int", "long"):
+        return isinstance(value, int) and not isinstance(value, bool)
+    if t in ("float", "double"):
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    if t in ("string", "enum"):
+        return isinstance(value, str)
+    if t == "bytes":
+        return isinstance(value, (bytes, bytearray))
+    if t == "array":
+        return isinstance(value, list)
+    if t in ("map", "record"):
+        return isinstance(value, dict)
+    return False
+
+
+# -- object container files --------------------------------------------------
+
+_MAGIC = b"Obj\x01"
+_SYNC = b"\x9aTPUavroSYNCmark"  # any 16 bytes
+
+
+class OcfWriter:
+    """Append-only OCF writer (null codec) — one block per flush."""
+
+    def __init__(self, path: str, schema: dict):
+        self.path = path
+        self.schema = schema
+        self._pending: list = []
+        if not os.path.exists(path) or os.path.getsize(path) == 0:
+            buf = io.BytesIO()
+            buf.write(_MAGIC)
+            meta = {
+                "avro.schema": json.dumps(schema).encode(),
+                "avro.codec": b"null",
+            }
+            write_long(buf, len(meta))
+            for k, v in meta.items():
+                encode_value("string", k, buf)
+                encode_value("bytes", v, buf)
+            write_long(buf, 0)
+            buf.write(_SYNC)
+            with open(path, "wb") as f:
+                f.write(buf.getvalue())
+
+    def append(self, record: dict) -> None:
+        self._pending.append(record)
+
+    def flush(self) -> None:
+        if not self._pending:
+            return
+        payload = io.BytesIO()
+        for r in self._pending:
+            encode_value(self.schema, r, payload)
+        block = io.BytesIO()
+        write_long(block, len(self._pending))
+        write_long(block, len(payload.getvalue()))
+        block.write(payload.getvalue())
+        block.write(_SYNC)
+        with open(self.path, "ab") as f:
+            f.write(block.getvalue())
+        self._pending = []
+
+
+def read_ocf_header(path: str):
+    """(schema, sync_marker, header_end_offset)."""
+    with open(path, "rb") as f:
+        if f.read(4) != _MAGIC:
+            raise ValueError("not an avro object container file")
+        meta = decode_value({"type": "map", "values": "bytes"}, f)
+        sync = f.read(16)
+        schema = json.loads(meta["avro.schema"].decode())
+        codec = meta.get("avro.codec", b"null")
+        if codec not in (b"null", b""):
+            raise ValueError(f"unsupported avro codec {codec!r}")
+        return schema, sync, f.tell()
+
+
+def read_blocks_from(
+    path: str, offset: int, schema, sync: bytes, max_records: int | None = None
+):
+    """(records, new_offset, corrupt): decode COMPLETE blocks from `offset`.
+
+    A truncated trailing block is left for the next poll (tail semantics);
+    `max_records` stops BETWEEN blocks once reached, with new_offset on the
+    boundary, so a large backlog drains across polls instead of wedging. A
+    corrupt block (bad sync marker / undecodable payload) returns the good
+    records decoded so far with corrupt=True and new_offset at the bad
+    block's start — the caller skips past the next sync marker and counts
+    the error (consume-and-skip, like the line tailer)."""
+    size = os.path.getsize(path)
+    records: list = []
+    with open(path, "rb") as f:
+        f.seek(offset)
+        while True:
+            start = f.tell()
+            if start >= size:
+                break
+            if max_records is not None and len(records) >= max_records:
+                return records, start, False
+            try:
+                count = read_long(f)
+                nbytes = read_long(f)
+            except EOFError:
+                return records, start, False  # torn framing: retry later
+            except ValueError:
+                return records, start, True
+            if count < 0 or nbytes < 0 or nbytes > (1 << 31):
+                return records, start, True
+            payload = f.read(nbytes)
+            marker = f.read(16)
+            if len(payload) != nbytes or len(marker) != 16:
+                return records, start, False  # incomplete: retry later
+            if marker != sync:
+                return records, start, True
+            try:
+                buf = io.BytesIO(payload)
+                block = [decode_value(schema, buf) for _ in range(count)]
+            except (ValueError, KeyError, IndexError, UnicodeDecodeError,
+                    EOFError, struct.error):
+                # framing was complete but the contents don't decode:
+                # a corrupt block, not a torn tail
+                return records, start, True
+            records.extend(block)
+            offset = f.tell()
+    return records, offset, False
+
+
+def skip_past_sync(path: str, offset: int, sync: bytes) -> int | None:
+    """Offset just past the next sync marker at/after `offset`, or None."""
+    with open(path, "rb") as f:
+        f.seek(offset)
+        data = f.read()
+    # the marker at position 0 would be the corrupt block's own framing;
+    # search from byte 1 so we always make progress
+    i = data.find(sync, 1)
+    return None if i < 0 else offset + i + 16

@@ -1,0 +1,129 @@
+"""Protobuf wire-format decoding against lightweight field descriptors.
+
+The analogue of the reference's protobuf interchange
+(src/interchange/src/protobuf.rs, which resolves compiled descriptors). No
+generated code: a message is described as {field_number: (name, type)} with
+type in {"int64","sint64","bool","string","bytes","double","float",
+"message:<sub>"} and decoding follows the proto3 wire format (varint,
+64-bit, length-delimited, 32-bit). Unknown fields are skipped, proto3
+implicit defaults apply, repeated scalar packing is accepted for varints.
+"""
+
+from __future__ import annotations
+
+import struct
+
+
+def _read_varint(data: bytes, i: int) -> tuple[int, int]:
+    shift = acc = 0
+    while True:
+        if i >= len(data):
+            raise EOFError("truncated varint")
+        b = data[i]
+        i += 1
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            return acc, i
+        shift += 7
+        if shift > 70:
+            raise ValueError("varint too long")
+
+
+def decode_message(data: bytes, desc: dict, registry: dict | None = None) -> dict:
+    """Decode one message. `desc` maps field number → (name, type);
+    `registry` maps sub-message names → their desc for "message:<name>"."""
+    registry = registry or {}
+    out: dict = {}
+    i = 0
+    n = len(data)
+    while i < n:
+        tag, i = _read_varint(data, i)
+        field, wire = tag >> 3, tag & 7
+        if wire == 0:  # varint
+            raw, i = _read_varint(data, i)
+            payload: object = raw
+        elif wire == 1:  # 64-bit
+            payload = data[i : i + 8]
+            i += 8
+        elif wire == 2:  # length-delimited
+            ln, i = _read_varint(data, i)
+            payload = data[i : i + ln]
+            if len(payload) != ln:
+                raise EOFError("truncated length-delimited field")
+            i += ln
+        elif wire == 5:  # 32-bit
+            payload = data[i : i + 4]
+            i += 4
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        spec = desc.get(field)
+        if spec is None:
+            continue  # unknown field: skipped, per proto3
+        name, typ = spec
+        out[name] = _convert(payload, typ, registry)
+    return out
+
+
+def _convert(payload, typ: str, registry: dict):
+    if typ == "int64":
+        v = int(payload)
+        return v - (1 << 64) if v >= (1 << 63) else v  # two's complement
+    if typ == "sint64":
+        v = int(payload)
+        return (v >> 1) ^ -(v & 1)
+    if typ == "bool":
+        return bool(payload)
+    if typ == "string":
+        return payload.decode()
+    if typ == "bytes":
+        return bytes(payload)
+    if typ == "double":
+        return struct.unpack("<d", payload)[0]
+    if typ == "float":
+        return struct.unpack("<f", payload)[0]
+    if typ.startswith("message:"):
+        sub = registry[typ.split(":", 1)[1]]
+        return decode_message(payload, sub, registry)
+    raise ValueError(f"unsupported proto type {typ!r}")
+
+
+def encode_message(values: dict, desc: dict, registry: dict | None = None) -> bytes:
+    """Inverse of decode_message (tests + fixtures)."""
+    registry = registry or {}
+    out = bytearray()
+
+    def varint(v: int) -> bytes:
+        b = bytearray()
+        v &= 0xFFFFFFFFFFFFFFFF
+        while True:
+            piece = v & 0x7F
+            v >>= 7
+            if v:
+                b.append(piece | 0x80)
+            else:
+                b.append(piece)
+                return bytes(b)
+
+    for field, (name, typ) in sorted(desc.items()):
+        if name not in values or values[name] is None:
+            continue
+        v = values[name]
+        if typ == "int64":
+            out += varint(field << 3 | 0) + varint(v)
+        elif typ == "sint64":
+            out += varint(field << 3 | 0) + varint((v << 1) ^ (v >> 63))
+        elif typ == "bool":
+            out += varint(field << 3 | 0) + varint(1 if v else 0)
+        elif typ in ("string", "bytes"):
+            raw = v.encode() if isinstance(v, str) else bytes(v)
+            out += varint(field << 3 | 2) + varint(len(raw)) + raw
+        elif typ == "double":
+            out += varint(field << 3 | 1) + struct.pack("<d", v)
+        elif typ == "float":
+            out += varint(field << 3 | 5) + struct.pack("<f", v)
+        elif typ.startswith("message:"):
+            sub = encode_message(v, registry[typ.split(":", 1)[1]], registry)
+            out += varint(field << 3 | 2) + varint(len(sub)) + sub
+        else:
+            raise ValueError(f"unsupported proto type {typ!r}")
+    return bytes(out)

@@ -250,7 +250,7 @@ class Parser:
                     raise ParseError(f"unknown file source option {key!r}")
                 self.eat_op(",")
             self.expect_op(")")
-        if fmt not in ("json", "csv"):
+        if fmt not in ("json", "csv", "avro"):
             raise ParseError(f"unsupported file source format {fmt!r}")
         envelope, key_cols = "none", ()
         if self.peek().kind == "IDENT" and self.peek().value == "envelope":

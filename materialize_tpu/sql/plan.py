@@ -410,6 +410,30 @@ class Planner:
                     "jsonb ordering comparisons are not supported "
                     "(equality and grouping are)"
                 )
+            if op in ("=", "<>") and {lt.col, rt.col} == {
+                ColType.JSONB, ColType.STRING
+            }:
+                # jsonb equality is CANONICAL-text equality: a verbatim text
+                # literal with different spacing/key order must re-encode
+                # canonically, or the code comparison is silently false
+                def canon(expr, t):
+                    if t.col != ColType.STRING:
+                        return expr
+                    if isinstance(expr, Literal) and expr.value is not None:
+                        from ..expr.strings import json_canonical
+
+                        try:
+                            txt = json_canonical(self.catalog.dict.decode(expr.value))
+                        except ValueError as exc:
+                            raise PlanError(
+                                f"invalid input syntax for type jsonb: {exc}"
+                            ) from exc
+                        return Literal(self.catalog.dict.encode(txt))
+                    return self._dictfunc(("jsonb_parse",), (expr,), ("str",), "string")
+
+                l, r = canon(l, lt), canon(r, rt)
+                fn = "eq" if op == "=" else "ne"
+                return CallBinary(fn, l, r), BOOL
             if (
                 op not in ("=", "<>")
                 and ColType.STRING in (lt.col, rt.col)

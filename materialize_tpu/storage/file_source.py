@@ -28,7 +28,7 @@ from dataclasses import dataclass, field
 @dataclass
 class FileSourceSpec:
     path: str
-    fmt: str  # "json" | "csv"
+    fmt: str  # "json" | "csv" | "avro" (object container file)
     col_names: tuple
     envelope: str = "none"  # "none" | "upsert"
     key_cols: tuple = ()  # column indices (upsert)
@@ -52,6 +52,8 @@ class FileTailSource:
         trailing line stays for the next poll (the external writer may be
         mid-append). Malformed lines are consumed-and-skipped (counted in
         decode_errors) — one bad record must never wedge ingestion."""
+        if self.spec.fmt == "avro":
+            return self._poll_avro(max_records)
         try:
             size = os.path.getsize(self.spec.path)
         except FileNotFoundError:
@@ -81,6 +83,43 @@ class FileTailSource:
             except (ValueError, KeyError, StopIteration):
                 self.decode_errors += 1
         return records, self.offset + consumed
+
+    def _poll_avro(self, max_records: int):
+        """Tail an Avro object container file block-by-block: the committed
+        offset sits on a block boundary (or 0 = before the header); a
+        truncated trailing block defers to the next poll — the same
+        complete-unit discipline as line tailing (interchange/avro.py)."""
+        from ..interchange import avro
+
+        try:
+            size = os.path.getsize(self.spec.path)
+        except FileNotFoundError:
+            return [], self.offset
+        if size <= self.offset:
+            return [], self.offset
+        try:
+            schema, sync, header_end = avro.read_ocf_header(self.spec.path)
+        except (ValueError, EOFError):
+            return [], self.offset  # header incomplete: retry later
+        start = max(self.offset, header_end)
+        raw, new_off, corrupt = avro.read_blocks_from(
+            self.spec.path, start, schema, sync, max_records=max_records
+        )
+        if corrupt:
+            # consume-and-skip: hop past the next sync marker so one bad
+            # block never wedges the source (good blocks before it are kept)
+            self.decode_errors += 1
+            resumed = avro.skip_past_sync(self.spec.path, new_off, sync)
+            new_off = resumed if resumed is not None else os.path.getsize(
+                self.spec.path
+            )
+        records = []
+        for doc in raw:
+            rec = {c: doc.get(c) for c in self.spec.col_names}
+            if "__diff__" in doc and doc["__diff__"] is not None:
+                rec["__diff__"] = doc["__diff__"]
+            records.append(rec)
+        return records, new_off
 
     def _decode(self, text: str) -> dict:
         if self.spec.fmt == "json":
