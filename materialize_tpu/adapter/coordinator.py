@@ -40,6 +40,29 @@ class ExecResult:
     status: str = "ok"
 
 
+def parse_replica_size(size: str) -> tuple[int, int]:
+    """Parse a replica size into (processes, workers_per_process).
+
+    The reference's cluster replica sizes name a process × worker split
+    (`src/adapter/src/catalog.rs` cluster_replica_sizes, e.g. "2-4" = 2
+    processes × 4 workers); here the spelling is "PxW": "2x4" is 2 clusterd
+    shard processes hosting 4 workers each, and a bare "4" is the
+    single-process 4-worker shape.
+    """
+    s = size.strip().lower()
+    try:
+        if "x" in s:
+            p_str, w_str = s.split("x", 1)
+            procs, workers = int(p_str), int(w_str)
+        else:
+            procs, workers = 1, int(s)
+    except ValueError:
+        raise ValueError(f"invalid replica size {size!r}: want 'PxW' or 'W'")
+    if procs < 1 or workers < 1:
+        raise ValueError(f"invalid replica size {size!r}: counts must be >= 1")
+    return procs, workers
+
+
 class StorageCollection:
     """Host-side durable collection of update batches (persist-lite).
 
@@ -96,6 +119,8 @@ class Coordinator:
             self.blob = FileBlob(f"{data_dir}/blob")
             self.consensus = FileConsensus(f"{data_dir}/consensus")
         self.shards: dict[str, object] = {}  # gid -> ShardMachine
+        # name -> (controller, orchestrator, owned) — see create_compute_replica
+        self._compute_replicas: dict[str, tuple] = {}
         # 0dt deployment state machine (deployment/state.rs:19-24 analogue):
         # init → catching-up (preflight, read-only) → leader; stale leaders
         # become "fenced" when a newer generation takes over.
@@ -1267,6 +1292,67 @@ class Coordinator:
                 raise
         return ts
 
+    # -- compute replicas ------------------------------------------------------
+    def create_compute_replica(
+        self, name: str, size: str, orchestrator=None, epoch: int = 1,
+        cpu: bool = True,
+    ):
+        """Allocate a compute replica of `size` ("PxW": processes × workers)
+        as real clusterd subprocesses reading this coordinator's persist
+        location, and return its controller (ShardedComputeController for
+        multi-worker sizes, ComputeController for "1"/"1x1").
+
+        The adapter-side half of CREATE CLUSTER REPLICA ... SIZE: the
+        coordinator owns the durable state (blob/consensus), the epoch, AND
+        the replica's process lifecycle — drop it with
+        `drop_compute_replica(name)` (a coordinator-owned orchestrator would
+        otherwise leak the clusterd processes). `cpu=True` pins the replica
+        processes to the CPU backend (tests/dev; pass cpu=False to let the
+        replicas claim the TPU plane). Requires a durable coordinator
+        (data_dir / FileBlob-backed) — clusterd hydrates from shards, never
+        from this process.
+        """
+        from ..cluster import ComputeController, ShardedComputeController
+        from ..orchestrator import ProcessOrchestrator
+
+        if not self.durable or not hasattr(self.blob, "root"):
+            raise RuntimeError(
+                "compute replicas need a file-backed coordinator (data_dir=...)"
+            )
+        if name in self._compute_replicas:
+            raise RuntimeError(f"compute replica {name!r} already exists")
+        processes, workers = parse_replica_size(size)
+        owned = orchestrator is None
+        if owned:
+            orchestrator = ProcessOrchestrator(cpu=cpu)
+        if processes == 1 and workers == 1:
+            addrs = orchestrator.ensure_service(name, scale=1)
+            ctl = ComputeController(
+                addrs, self.blob.root, self.consensus.root, epoch=epoch
+            )
+        else:
+            addrs, mesh_addrs = orchestrator.ensure_sharded_service(
+                name, processes, workers_per_process=workers
+            )
+            ctl = ShardedComputeController(
+                addrs,
+                mesh_addrs,
+                workers,
+                self.blob.root,
+                self.consensus.root,
+                epoch=epoch,
+            )
+        self._compute_replicas[name] = (ctl, orchestrator, owned)
+        return ctl
+
+    def drop_compute_replica(self, name: str) -> None:
+        """Tear down a replica created here: close the controller and stop
+        its clusterd processes (only if this coordinator spawned them)."""
+        ctl, orchestrator, owned = self._compute_replicas.pop(name)
+        ctl.close()
+        if owned:
+            orchestrator.drop_service(name)
+
     # -- external file sources -------------------------------------------------
     def _poll_file_sources(self, writes: dict, ts: int, max_records: int):
         """Ingest new records from every file source into `writes`; returns
@@ -1851,6 +1937,16 @@ def _eval_scalar_on_row(e, row: list):
             return min(nn) if nn else None
     if isinstance(e, s.DictFunc):
         vs = [_eval_scalar_on_row(a, row) for a in e.args]
+        if e.spec[0] == "concat_ws":
+            # NULL args are skipped (passed as None); NULL separator → NULL
+            if vs[0] is None:
+                return None
+            args = [
+                None if v is None else e.tables._decode_arg(at, v)
+                for at, v in zip(e.argtypes, vs)
+            ]
+            r = e.tables.eval_one(e.spec, args)
+            return None if r is None else e.tables.dct.encode(r)
         if any(v is None for v in vs):
             return None
         args = [e.tables._decode_arg(at, v) for at, v in zip(e.argtypes, vs)]

@@ -1,4 +1,12 @@
 from . import protocol
-from .controller import ComputeController, ReplicaClient
+from .controller import ComputeController, ReplicaClient, ShardedComputeController
+from .mesh import MeshError, WorkerMesh
 
-__all__ = ["protocol", "ComputeController", "ReplicaClient"]
+__all__ = [
+    "protocol",
+    "ComputeController",
+    "ReplicaClient",
+    "ShardedComputeController",
+    "MeshError",
+    "WorkerMesh",
+]

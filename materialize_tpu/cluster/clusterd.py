@@ -7,11 +7,25 @@ handle_compute_command), pulls source data from persist shards (never from
 the controller), answers peeks, and reports frontiers. Restart + reconnect is
 safe because the controller replays its command history (reconciliation) and
 all inputs re-hydrate from shards.
+
+Two execution modes:
+
+* **Whole replica** (default): one Dataflow per installed dataflow holding
+  full state — active-active HA across replicas.
+* **Shard of a replica** (after FormMesh, requires --mesh-port): this
+  process hosts `workers_per_process` worker threads, each rendering the
+  same dataflows with a ShardContext over the epoch-fenced WorkerMesh
+  (cluster/mesh.py). Source rows are routed by whole-row hash so each worker
+  ingests only its partition; exchange pacts inside the rendered dataflow
+  re-route by operator keys. Tick-driving commands (CreateDataflow
+  hydration, ProcessTo) fan out to all local workers CONCURRENTLY — workers
+  block on each other's exchange parts, so serializing them would deadlock.
 """
 
 from __future__ import annotations
 
 import argparse
+import queue
 import socket
 import sys
 import threading
@@ -19,9 +33,77 @@ import threading
 import numpy as np
 
 from ..dataflow import Dataflow
+from ..dataflow.runtime import ShardContext
 from ..persist import FileBlob, FileConsensus, ShardMachine
 from ..repr.batch import UpdateBatch
 from . import protocol as p
+from .mesh import MeshError, WorkerMesh
+
+
+class ShardWorker:
+    """One worker thread of a sharded replica process.
+
+    Owns its partition's Dataflow instances; executes jobs posted by the
+    command handler. Jobs run concurrently across the process's workers (and
+    across processes), meeting each other at mesh exchanges.
+    """
+
+    def __init__(self, global_index: int, mesh: WorkerMesh, state: "ClusterState"):
+        self.global_index = global_index
+        self.mesh = mesh
+        self.state = state
+        self.dataflows: dict[str, dict] = {}
+        self.jobs: queue.Queue = queue.Queue()
+        self.thread = threading.Thread(target=self._run, daemon=True)
+        self.thread.start()
+
+    def _run(self) -> None:
+        while True:
+            job = self.jobs.get()
+            if job is None:
+                return
+            fn, done, result = job
+            try:
+                result.append(fn(self))
+            except Exception as e:  # surfaced as CommandErr by the handler
+                result.append(e)
+            done.set()
+
+    def stop(self) -> None:
+        self.jobs.put(None)
+
+
+def _run_on_workers(workers: list, fn):
+    """Post `fn(worker)` to every worker, wait for all, return results;
+    raises the first exception (after all workers finished or failed)."""
+    pending = []
+    for w in workers:
+        done = threading.Event()
+        result: list = []
+        w.jobs.put((fn, done, result))
+        pending.append((done, result))
+    outs = []
+    first_err = None
+    for done, result in pending:
+        done.wait()
+        r = result[0]
+        if isinstance(r, Exception) and first_err is None:
+            first_err = r
+        outs.append(r)
+    if first_err is not None:
+        raise first_err
+    return outs
+
+
+def _partition_source(cols: dict, n_workers: int) -> list:
+    """All workers' partitions of a source column dict in ONE hashing pass
+    (whole-row hash — deterministic in the VALUES only, so any later
+    retraction of a row is ingested by the same worker as its insert)."""
+    from ..parallel.netexchange import partition_cols
+
+    if n_workers == 1:
+        return [cols]
+    return partition_cols(cols, None, n_workers)
 
 
 class ClusterState:
@@ -29,8 +111,17 @@ class ClusterState:
         self.blob = None
         self.consensus = None
         self.epoch = -1
-        # dataflow_id -> dict(df, source_shards, frontier)
+        # dataflow_id -> dict(df, source_shards, frontier)  (whole-replica mode)
         self.dataflows: dict[str, dict] = {}
+        # sharded mode (set by FormMesh)
+        self.mesh: WorkerMesh | None = None
+        self.workers: list[ShardWorker] = []
+        # dataflow_id -> dict(desc, source_shards, as_of, frontier)
+        self.sharded_dataflows: dict[str, dict] = {}
+
+    @property
+    def sharded(self) -> bool:
+        return bool(self.workers)
 
     # -- command handlers (compute_state.rs:516 analogue) ---------------------
     def handle(self, cmd):
@@ -41,6 +132,8 @@ class ClusterState:
             return p.Pong(self.epoch)
         if isinstance(cmd, p.Ping):
             return p.Pong(self.epoch)
+        if isinstance(cmd, p.FormMesh):
+            return self._form_mesh(cmd)
         if isinstance(cmd, p.CreateInstance):
             self.blob = FileBlob(cmd.blob_path)
             self.consensus = FileConsensus(cmd.consensus_path)
@@ -48,6 +141,18 @@ class ClusterState:
         if isinstance(cmd, p.CreateDataflow):
             return self._create_dataflow(cmd)
         if isinstance(cmd, p.AllowCompaction):
+            if self.sharded:
+                st = self.sharded_dataflows.get(cmd.dataflow_id)
+                if st is not None:
+                    def compact(w, df_id=cmd.dataflow_id, since=cmd.since):
+                        wst = w.dataflows.get(df_id)
+                        if wst is not None:
+                            wst["df"].compact(since)
+                    try:
+                        _run_on_workers(self.workers, compact)
+                    except Exception as e:
+                        return p.CommandErr(str(e))
+                return p.Frontiers(self._uppers())
             st = self.dataflows.get(cmd.dataflow_id)
             if st is not None:
                 st["df"].compact(cmd.since)
@@ -58,7 +163,42 @@ class ClusterState:
             return self._peek(cmd)
         return p.CommandErr(f"unknown command {type(cmd).__name__}")
 
+    # -- sharded mode ---------------------------------------------------------
+    def _form_mesh(self, cmd: p.FormMesh):
+        """Join (or re-form) the worker mesh at cmd.epoch. All dataflow state
+        is dropped: a sharded replica's state partitions are rebuilt together
+        by the controller's history replay, so a restarted shard can never
+        hold batches from a different epoch than its peers."""
+        if cmd.epoch < self.epoch:
+            return p.CommandErr(f"fenced: stale epoch {cmd.epoch} < {self.epoch}")
+        self.epoch = cmd.epoch
+        if self.mesh is None:
+            return p.CommandErr("clusterd was started without --mesh-port")
+        for w in self.workers:
+            w.stop()
+        self.workers = []
+        self.dataflows.clear()
+        self.sharded_dataflows.clear()
+        try:
+            self.mesh.form(
+                cmd.epoch,
+                cmd.process_index,
+                cmd.n_processes,
+                cmd.workers_per_process,
+                list(cmd.peer_mesh_addrs),
+            )
+        except MeshError as e:
+            return p.CommandErr(str(e))
+        base = cmd.process_index * cmd.workers_per_process
+        self.workers = [
+            ShardWorker(base + i, self.mesh, self)
+            for i in range(cmd.workers_per_process)
+        ]
+        return p.MeshReady(cmd.epoch, self.mesh.n_workers)
+
     def _create_dataflow(self, cmd: p.CreateDataflow):
+        if self.sharded:
+            return self._create_dataflow_sharded(cmd)
         if cmd.dataflow_id in self.dataflows:
             # reconciliation replay: already installed, keep as-is
             return p.Frontiers(self._uppers())
@@ -85,9 +225,61 @@ class ClusterState:
         df.frontier = cmd.as_of + 1
         return p.Frontiers(self._uppers())
 
+    def _create_dataflow_sharded(self, cmd: p.CreateDataflow):
+        if cmd.dataflow_id in self.sharded_dataflows:
+            return p.Frontiers(self._uppers())
+        n_workers = self.mesh.n_workers
+        # read + partition snapshots ONCE per process; workers index in
+        snaps_parts: dict[str, list] = {}  # gid -> [per-batch parts lists]
+        for gid, shard_id in cmd.source_shards.items():
+            m = ShardMachine(self.blob, self.consensus, shard_id)
+            _seq, state = m.fetch_state()
+            if state.batches:
+                at = max(min(cmd.as_of, state.upper - 1), state.since)
+                batches = m.snapshot(at)
+                if batches:
+                    snaps_parts[gid] = [
+                        _partition_source(c, n_workers) for c in batches
+                    ]
+
+        def create(w: ShardWorker):
+            shard_ctx = ShardContext(
+                self.mesh, cmd.dataflow_id, w.global_index, n_workers
+            )
+            df = Dataflow(cmd.desc, shard=shard_ctx)
+            snaps = {}
+            for gid, batch_parts in snaps_parts.items():
+                parts = [
+                    bp[w.global_index]
+                    for bp in batch_parts
+                    if bp[w.global_index] is not None
+                ]
+                if parts:
+                    snaps[gid] = _cols_to_batch(parts, cmd.as_of)
+            # the hydration tick runs on EVERY worker even with no local
+            # snapshot rows: its exchanges are a mesh-wide barrier
+            df.step(cmd.as_of, snaps)
+            df.frontier = cmd.as_of + 1
+            w.dataflows[cmd.dataflow_id] = {"df": df, "frontier": cmd.as_of + 1}
+            return None
+
+        try:
+            _run_on_workers(self.workers, create)
+        except Exception as e:
+            return p.CommandErr(f"sharded create_dataflow failed: {e}")
+        self.sharded_dataflows[cmd.dataflow_id] = {
+            "desc": cmd.desc,
+            "source_shards": dict(cmd.source_shards),
+            "as_of": cmd.as_of,
+            "frontier": cmd.as_of + 1,
+        }
+        return p.Frontiers(self._uppers())
+
     def _process_to(self, upper: int):
         """Pull new shard data and step dataflows tick by tick (the worker
         loop: server.rs:356 analogue, driven by explicit ProcessTo)."""
+        if self.sharded:
+            return self._process_to_sharded(upper)
         for df_id, st in self.dataflows.items():
             df = st["df"]
             lo = st["frontier"]
@@ -118,7 +310,81 @@ class ClusterState:
             df.frontier = upper
         return p.Frontiers(self._uppers())
 
+    def _process_to_sharded(self, upper: int):
+        """Sharded ProcessTo: every worker steps EVERY tick in [lo, upper) —
+        the per-tick exchanges are how peers learn a timestamp is closed, so
+        the tick sequence must be identical mesh-wide even where a worker
+        (or the whole replica) has no local data for a tick."""
+        n_workers = self.mesh.n_workers
+        for df_id, st in self.sharded_dataflows.items():
+            lo = st["frontier"]
+            if upper <= lo:
+                continue
+            # read + partition the shard listens once per process
+            per_source: dict[str, list] = {}  # gid -> [per-batch parts lists]
+            for gid, shard_id in st["source_shards"].items():
+                m = ShardMachine(self.blob, self.consensus, shard_id)
+                batches, _shard_upper = m.listen_from(lo)
+                subs = []
+                for cols in batches:
+                    mask = cols["times"] < np.uint64(upper)
+                    if mask.any():
+                        sub = {k: v[mask] for k, v in cols.items()}
+                        subs.append(_partition_source(sub, n_workers))
+                if subs:
+                    per_source[gid] = subs
+
+            def advance(w: ShardWorker, df_id=df_id, per_source=per_source):
+                wst = w.dataflows[df_id]
+                df = wst["df"]
+                per_time: dict[int, dict[str, list]] = {}
+                for gid, subs in per_source.items():
+                    for parts in subs:
+                        part = parts[w.global_index]
+                        if part is None:
+                            continue
+                        for t in np.unique(part["times"]):
+                            tmask = part["times"] == t
+                            per_time.setdefault(int(t), {}).setdefault(
+                                gid, []
+                            ).append({k: v[tmask] for k, v in part.items()})
+                for t in range(lo, upper):
+                    deltas = {
+                        gid: _cols_to_batch(parts, None)
+                        for gid, parts in per_time.get(t, {}).items()
+                    }
+                    df.step(t, deltas)
+                wst["frontier"] = upper
+                df.frontier = upper
+                return None
+
+            try:
+                _run_on_workers(self.workers, advance)
+            except Exception as e:
+                return p.CommandErr(f"sharded process_to failed: {e}")
+            st["frontier"] = upper
+        return p.Frontiers(self._uppers())
+
     def _peek(self, cmd: p.Peek):
+        if self.sharded:
+            st = self.sharded_dataflows.get(cmd.dataflow_id)
+            if st is None:
+                return p.PeekResponse(
+                    cmd.uuid, None, f"unknown dataflow {cmd.dataflow_id}"
+                )
+
+            def peek(w: ShardWorker):
+                return w.dataflows[cmd.dataflow_id]["df"].peek(
+                    cmd.index_id, at=cmd.at
+                )
+
+            try:
+                parts = _run_on_workers(self.workers, peek)
+            except Exception as e:
+                return p.PeekResponse(cmd.uuid, None, str(e))
+            # a process-local multiset union; the controller merges processes
+            rows = [r for part in parts for r in part]
+            return p.PeekResponse(cmd.uuid, rows)
         st = self.dataflows.get(cmd.dataflow_id)
         if st is None:
             return p.PeekResponse(cmd.uuid, None, f"unknown dataflow {cmd.dataflow_id}")
@@ -129,6 +395,8 @@ class ClusterState:
             return p.PeekResponse(cmd.uuid, None, str(e))
 
     def _uppers(self) -> dict:
+        if self.sharded:
+            return {k: st["frontier"] for k, st in self.sharded_dataflows.items()}
         return {k: st["frontier"] for k, st in self.dataflows.items()}
 
 
@@ -153,13 +421,17 @@ def _cols_to_batch(col_dicts, advance_to) -> UpdateBatch:
     )
 
 
-def serve(host: str, port: int):
+def serve(host: str, port: int, mesh_port: int | None = None):
     """Listen for controller connections (thread per connection; command
     handling is serialized by a lock — the worker loop is single-threaded as
     in the reference, but a newer-generation controller can always get in to
-    fence the old one via its epoch)."""
+    fence the old one via its epoch). With `mesh_port`, the shard-mesh
+    listener starts immediately so peer processes can dial before our own
+    FormMesh command arrives."""
     state = ClusterState()
     lock = threading.Lock()
+    if mesh_port is not None:
+        state.mesh = WorkerMesh(host, mesh_port)
     srv = socket.create_server((host, port), reuse_port=False)
     srv.listen(4)
     print(f"clusterd listening on {host}:{port}", flush=True)
@@ -187,6 +459,12 @@ def main() -> None:
     ap = argparse.ArgumentParser(prog="clusterd")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, required=True)
+    ap.add_argument(
+        "--mesh-port",
+        type=int,
+        default=None,
+        help="listen port of the sharded-replica worker mesh (cluster/mesh.py)",
+    )
     ap.add_argument("--cpu", action="store_true", help="force CPU jax (tests)")
     args = ap.parse_args()
     if args.cpu:
@@ -202,7 +480,7 @@ def main() -> None:
                 _xb._backend_factories.pop(name, None)
         except Exception:
             pass
-    serve(args.host, args.port)
+    serve(args.host, args.port, mesh_port=args.mesh_port)
 
 
 if __name__ == "__main__":

@@ -7,6 +7,14 @@ commands out to every replica, replays history on replica (re)connect
 each peek from the FIRST replica that responds
 (absorb_peek_response, src/compute-client/src/service.rs:219) — replicas are
 identical and stateless, so any of them can serve (active-active HA).
+
+`ShardedComputeController` drives the OTHER replica shape: one replica
+sharded across N clusterd processes × W workers (cluster/mesh.py). State is
+partitioned, so commands fan out to every shard CONCURRENTLY (tick-driving
+commands block on cross-shard exchanges — sending them one shard at a time
+would deadlock), peeks must merge EVERY shard's partition, frontiers are the
+min across shards, and recovery is a mesh reformation at a bumped epoch
+followed by a full history replay.
 """
 
 from __future__ import annotations
@@ -18,6 +26,25 @@ import uuid as uuidlib
 from dataclasses import dataclass, field
 
 from . import protocol as p
+
+
+def reduce_command_history(history: list, cmd) -> list:
+    """Command-history reduction (protocol/history.rs analogue): keep the
+    history replayable but minimal — only the latest ProcessTo matters, and
+    per-dataflow only the latest AllowCompaction. Shared by both controller
+    flavors so replay semantics can never diverge."""
+    if isinstance(cmd, p.ProcessTo):
+        history = [c for c in history if not isinstance(c, p.ProcessTo)]
+    elif isinstance(cmd, p.AllowCompaction):
+        history = [
+            c
+            for c in history
+            if not (
+                isinstance(c, p.AllowCompaction)
+                and c.dataflow_id == cmd.dataflow_id
+            )
+        ]
+    return history + [cmd]
 
 
 class ReplicaClient:
@@ -115,28 +142,11 @@ class ComputeController:
         self.replicas[i] = r
         return r
 
-    def _reduce_history(self, cmd) -> None:
-        """Command-history reduction (protocol/history.rs analogue): keep the
-        history replayable but minimal — only the latest ProcessTo matters,
-        and per-dataflow only the latest AllowCompaction."""
-        if isinstance(cmd, p.ProcessTo):
-            self.history = [c for c in self.history if not isinstance(c, p.ProcessTo)]
-        elif isinstance(cmd, p.AllowCompaction):
-            self.history = [
-                c
-                for c in self.history
-                if not (
-                    isinstance(c, p.AllowCompaction)
-                    and c.dataflow_id == cmd.dataflow_id
-                )
-            ]
-        self.history.append(cmd)
-
     def _broadcast(self, cmd, record: bool = True):
         """Send to every reachable replica; a dead replica is dropped (it will
         be reconciled on reconnect)."""
         if record:
-            self._reduce_history(cmd)
+            self.history = reduce_command_history(self.history, cmd)
         out = []
         for i in range(len(self.addrs)):
             r = self._ensure_replica(i)
@@ -241,5 +251,170 @@ class ComputeController:
     def close(self) -> None:
         self.stop_heartbeats()
         for r in self.replicas:
+            if r is not None:
+                r.close()
+
+
+class ShardedComputeController:
+    """Controller for ONE replica running as a shard set.
+
+    `shard_addrs`/`mesh_addrs`: per-process command and mesh endpoints (the
+    orchestrator's ensure_sharded_service output). The mesh is formed at
+    construction; `reform()` recovers from a shard process restart by bumping
+    the epoch (fencing any in-flight batches of the old generation) and
+    replaying the reduced command history against ALL shards — the
+    reference's whole-replica rehydration on process failure.
+    """
+
+    def __init__(
+        self,
+        shard_addrs: list,
+        mesh_addrs: list,
+        workers_per_process: int,
+        blob_path: str,
+        consensus_path: str,
+        epoch: int = 1,
+    ):
+        self.shard_addrs = [tuple(a) for a in shard_addrs]
+        self.mesh_addrs = [tuple(a) for a in mesh_addrs]
+        self.workers_per_process = workers_per_process
+        self.epoch = epoch
+        self.history: list = [p.CreateInstance(blob_path, consensus_path)]
+        self.shards: list[ReplicaClient | None] = [None] * len(self.shard_addrs)
+        self.frontier = 0
+        self._connect_and_form()
+        for cmd in self.history:
+            self._broadcast(cmd, record=False)
+
+    @property
+    def n_processes(self) -> int:
+        return len(self.shard_addrs)
+
+    @property
+    def n_workers(self) -> int:
+        return self.n_processes * self.workers_per_process
+
+    # -- mesh lifecycle ----------------------------------------------------
+    def _connect_and_form(self) -> None:
+        for i in range(self.n_processes):
+            r = ReplicaClient(self.shard_addrs[i], self.epoch)
+            r.connect()
+            self.shards[i] = r
+        # FormMesh must land on every process concurrently: each blocks
+        # until its pairwise connections for this epoch are up
+        resps = self._request_all(
+            [
+                p.FormMesh(
+                    self.epoch,
+                    i,
+                    self.n_processes,
+                    self.workers_per_process,
+                    tuple(self.mesh_addrs),
+                )
+                for i in range(self.n_processes)
+            ]
+        )
+        for i, resp in enumerate(resps):
+            if not isinstance(resp, p.MeshReady):
+                raise ConnectionError(
+                    f"shard {i} failed to join the mesh: {resp!r}"
+                )
+
+    def reform(self) -> None:
+        """Recover after a shard process restart: new epoch, fresh mesh,
+        full history replay (every shard rebuilds its partition together —
+        batches from the old epoch can never mix in)."""
+        self.epoch += 1
+        for r in self.shards:
+            if r is not None:
+                r.close()
+        self._connect_and_form()
+        for cmd in self.history:
+            self._broadcast(cmd, record=False)
+
+    # -- command fan-out ---------------------------------------------------
+    def _request_all(self, cmds: list):
+        """One command per shard, all in flight at once (tick-driving
+        commands meet at mesh exchanges and MUST overlap)."""
+        resps: list = [None] * self.n_processes
+        errs: list = [None] * self.n_processes
+
+        def run(i: int) -> None:
+            r = self.shards[i]
+            if r is None:
+                errs[i] = ConnectionError(f"shard {i} not connected")
+                return
+            try:
+                resps[i] = r.request(cmds[i])
+            except (ConnectionError, OSError) as e:
+                errs[i] = e
+
+        threads = [
+            threading.Thread(target=run, args=(i,)) for i in range(self.n_processes)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for i, e in enumerate(errs):
+            if e is not None:
+                raise ConnectionError(f"shard {i} ({self.shard_addrs[i]}): {e}")
+        return resps
+
+    def _broadcast(self, cmd, record: bool = True):
+        if record:
+            self.history = reduce_command_history(self.history, cmd)
+        resps = self._request_all([cmd] * self.n_processes)
+        for i, resp in enumerate(resps):
+            if isinstance(resp, p.CommandErr):
+                raise RuntimeError(f"shard {i}: {resp.message}")
+        return resps
+
+    # -- public API --------------------------------------------------------
+    def create_dataflow(self, dataflow_id: str, desc, source_shards: dict, as_of: int):
+        self._broadcast(p.CreateDataflow(dataflow_id, desc, source_shards, as_of))
+
+    def allow_compaction(self, dataflow_id: str, since: int):
+        self._broadcast(p.AllowCompaction(dataflow_id, since))
+
+    def process_to(self, upper: int):
+        resps = self._broadcast(p.ProcessTo(upper))
+        self.frontier = upper
+        return resps
+
+    def frontiers(self) -> dict:
+        """Per-dataflow write frontier: the MIN across shards (a timestamp is
+        only complete once every partition has processed it)."""
+        resps = self._broadcast(p.ProcessTo(0), record=False)
+        merged: dict = {}
+        for resp in resps:
+            for df_id, upper in resp.uppers.items():
+                cur = merged.get(df_id)
+                merged[df_id] = upper if cur is None else min(cur, upper)
+        return merged
+
+    def peek(self, dataflow_id: str, index_id: str, at=None):
+        """Every shard holds a disjoint partition: fan out, require ALL
+        responses, and merge into the canonical output order."""
+        uid = uuidlib.uuid4().hex
+        resps = self._request_all(
+            [p.Peek(uid, dataflow_id, index_id, at)] * self.n_processes
+        )
+        rows: list = []
+        for i, resp in enumerate(resps):
+            if not isinstance(resp, p.PeekResponse):
+                raise RuntimeError(f"shard {i}: unexpected {resp!r}")
+            if resp.error is not None:
+                raise RuntimeError(f"peek {index_id}: shard {i}: {resp.error}")
+            rows.extend(resp.rows)
+        # merged partitions re-sort with THE canonical peek order so the
+        # result is byte-identical to the 1-process path
+        from ..dataflow.runtime import peek_row_key
+
+        rows.sort(key=peek_row_key)
+        return rows
+
+    def close(self) -> None:
+        for r in self.shards:
             if r is not None:
                 r.close()

@@ -1,0 +1,346 @@
+"""WorkerMesh — the cross-process sharded data plane.
+
+The analogue of the reference's TCP worker mesh
+(`src/cluster/src/communication.rs:100`): one replica runs as N `clusterd`
+shard processes, each hosting W workers (global worker g lives on process
+g // W). Processes connect pairwise over the framed CTP transport
+(`protocol.send_frame`), and every connection multiplexes the exchange
+channels between all worker pairs on its two endpoints — exactly the
+reference's "one socket per process pair, all timely channels ride it".
+
+Three guarantees, mapped to the tentpole requirements:
+
+* **Hash-partitioned exchange.** `exchange()` ships per-destination
+  `(row, time, diff)` column parts (staged by `parallel/netexchange.py`) and
+  returns once every peer's part for `(channel, tick)` has arrived.
+
+* **Progress accounting.** Every worker sends exactly one frame — possibly
+  an empty punctuation — per (channel, tick) to every worker. The inbox
+  counts arrivals per (dst, channel, tick); a timestamp closes (exchange
+  returns, the caller may fold the batches into state) only when all
+  `n_workers` parts are present. The per-channel `frontier()` is the largest
+  closed tick, asserted monotonic.
+
+* **Epoch-fenced (re)formation.** `form(epoch, ...)` tears down the previous
+  epoch's connections and inbox before any new-epoch frame is accepted, and
+  data frames carry their epoch and are dropped unless current, so a batch
+  can never split across epochs (communication.rs:253-284). A peer
+  handshaking with a stale epoch is refused with "fenced"; a restarted shard
+  rejoins only via a full reformation at a higher epoch driven by the
+  controller (which then replays its command history, rebuilding all shards'
+  state together from persist).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from . import protocol as p
+
+# wire frames (length-prefixed pickles, protocol.py framing)
+#   ("hello", epoch, from_process)        handshake, dialer -> acceptor
+#   ("ok", epoch) | ("fenced", epoch)     handshake reply
+#   ("data", epoch, channel, tick, src_worker, dst_worker, payload)
+
+
+class MeshError(RuntimeError):
+    """A peer died or fenced us mid-epoch; the controller must reform."""
+
+
+class _Inbox:
+    """Per-process arrival table: (epoch, dst, channel, tick) -> {src: part}.
+
+    The epoch is part of the key so a frame that was read off a socket just
+    before a reformation and delivered just after can only land in a dead
+    slot — it can never complete (or pollute) a new-epoch exchange."""
+
+    def __init__(self) -> None:
+        self._cv = threading.Condition()
+        self._slots: dict = {}
+        self._failed: Optional[str] = None
+        # (epoch, dst, channel) -> last closed tick (progress frontier)
+        self._frontiers: dict = {}
+
+    def deliver(
+        self, epoch: int, dst: int, channel, tick: int, src: int, part
+    ) -> None:
+        with self._cv:
+            self._slots.setdefault((epoch, dst, channel, tick), {})[src] = part
+            self._cv.notify_all()
+
+    def fail(self, reason: str) -> None:
+        with self._cv:
+            self._failed = reason
+            self._cv.notify_all()
+
+    def collect(
+        self, epoch: int, dst: int, channel, tick: int, n: int, timeout: float
+    ):
+        """Block until all `n` parts for (channel, tick) addressed to `dst`
+        arrived; returns them ordered by source worker and closes the tick."""
+        key = (epoch, dst, channel, tick)
+        with self._cv:
+            ok = self._cv.wait_for(
+                lambda: self._failed is not None
+                or len(self._slots.get(key, {})) >= n,
+                timeout=timeout,
+            )
+            slot = self._slots.get(key, {})
+            if len(slot) < n:
+                if self._failed is not None:
+                    raise MeshError(f"mesh failed: {self._failed}")
+                if not ok:
+                    raise MeshError(
+                        f"exchange timeout: channel {channel} tick {tick} has "
+                        f"{len(slot)}/{n} parts"
+                    )
+            del self._slots[key]
+            fkey = (epoch, dst, channel)
+            last = self._frontiers.get(fkey)
+            if last is not None and tick <= last:
+                raise MeshError(
+                    f"progress violation: channel {channel} closed tick {tick} "
+                    f"at or below its frontier {last}"
+                )
+            self._frontiers[fkey] = tick
+            return [slot[s] for s in range(n)]
+
+    def clear(self) -> None:
+        with self._cv:
+            self._slots.clear()
+            self._frontiers.clear()
+            self._failed = None
+            self._cv.notify_all()
+
+
+class WorkerMesh:
+    """One process's endpoint of the shard mesh.
+
+    The listener runs from construction (clusterd start) so reformation never
+    races process startup; connections and the inbox belong to the CURRENT
+    epoch only. `form()` (driven by the controller's FormMesh command)
+    transitions epochs atomically with respect to the data plane.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.RLock()
+        self.epoch = -1
+        self.process_index = 0
+        self.n_processes = 1
+        self.workers_per_process = 1
+        self._conns: dict[int, socket.socket] = {}  # peer process -> sock
+        self._send_locks: dict[int, threading.Lock] = {}
+        self.inbox = _Inbox()
+        # accepted-but-not-yet-adopted sockets: epoch -> {from_process: sock}
+        self._pending: dict[int, dict[int, socket.socket]] = {}
+        self._pending_cv = threading.Condition(self._lock)
+        self._srv = socket.create_server((host, port))
+        self.addr = self._srv.getsockname()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    # -- formation ---------------------------------------------------------
+    @property
+    def n_workers(self) -> int:
+        return self.n_processes * self.workers_per_process
+
+    def process_of(self, worker: int) -> int:
+        return worker // self.workers_per_process
+
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._handshake, args=(conn,), daemon=True
+            ).start()
+
+    def _handshake(self, conn: socket.socket) -> None:
+        try:
+            frame = p.recv_frame(conn)
+            if not (isinstance(frame, tuple) and frame[0] == "hello"):
+                conn.close()
+                return
+            _tag, epoch, from_process = frame
+            with self._lock:
+                if epoch < self.epoch:
+                    p.send_frame(conn, ("fenced", self.epoch))
+                    conn.close()
+                    return
+                p.send_frame(conn, ("ok", epoch))
+                # stash until the local form() for this epoch adopts it —
+                # the dialer may handshake before OUR FormMesh arrives
+                self._pending.setdefault(epoch, {})[from_process] = conn
+                self._pending_cv.notify_all()
+        except (OSError, ConnectionError, EOFError):
+            conn.close()
+
+    def form(
+        self,
+        epoch: int,
+        process_index: int,
+        n_processes: int,
+        workers_per_process: int,
+        peer_addrs: list,
+        timeout: float = 30.0,
+    ) -> None:
+        """(Re)form the full mesh at `epoch`. Dials every lower-indexed peer
+        and waits for every higher-indexed peer's dial; the previous epoch's
+        connections and in-flight batches are discarded first."""
+        import time as _time
+
+        with self._lock:
+            if epoch < self.epoch:
+                raise MeshError(f"fenced: form at stale epoch {epoch} < {self.epoch}")
+            for sock in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            self._send_locks.clear()
+            self.inbox.clear()
+            self.epoch = epoch
+            self.process_index = process_index
+            self.n_processes = n_processes
+            self.workers_per_process = workers_per_process
+            # drop stale pending handshakes
+            for e in [e for e in self._pending if e < epoch]:
+                for sock in self._pending[e].values():
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                del self._pending[e]
+        if n_processes == 1:
+            return
+        deadline = _time.time() + timeout
+        # dial lower-indexed peers (they accept); higher-indexed peers dial us
+        for j in range(process_index):
+            sock = self._dial(peer_addrs[j], epoch, deadline)
+            with self._lock:
+                self._adopt(j, sock)
+        with self._lock:
+            expect = set(range(process_index + 1, n_processes))
+            while expect - set(self._conns):
+                got = self._pending.get(epoch, {})
+                for j in list(expect & set(got)):
+                    self._adopt(j, got.pop(j))
+                if not (expect - set(self._conns)):
+                    break
+                remaining = deadline - _time.time()
+                if remaining <= 0 or not self._pending_cv.wait(timeout=remaining):
+                    missing = sorted(expect - set(self._conns))
+                    raise MeshError(
+                        f"mesh formation timeout at epoch {epoch}: "
+                        f"no connection from processes {missing}"
+                    )
+
+    def _dial(self, addr, epoch: int, deadline: float) -> socket.socket:
+        import time as _time
+
+        last: Exception | None = None
+        while _time.time() < deadline:
+            try:
+                sock = socket.create_connection(tuple(addr), timeout=2.0)
+                p.send_frame(sock, ("hello", epoch, self.process_index))
+                reply = p.recv_frame(sock)
+                if isinstance(reply, tuple) and reply[0] == "ok":
+                    return sock
+                sock.close()
+                if isinstance(reply, tuple) and reply[0] == "fenced":
+                    raise MeshError(
+                        f"fenced: peer {addr} is at epoch {reply[1]} > {epoch}"
+                    )
+                last = ConnectionError(f"bad handshake reply {reply!r}")
+            except (ConnectionError, OSError) as e:
+                last = e
+                _time.sleep(0.05)
+        raise MeshError(f"cannot reach mesh peer {addr}: {last}")
+
+    def _adopt(self, peer: int, sock: socket.socket) -> None:
+        """Register a handshaken connection and start its receiver (lock held)."""
+        sock.settimeout(None)
+        self._conns[peer] = sock
+        self._send_locks[peer] = threading.Lock()
+        threading.Thread(
+            target=self._recv_loop, args=(peer, sock, self.epoch), daemon=True
+        ).start()
+
+    # -- data plane --------------------------------------------------------
+    def _recv_loop(self, peer: int, sock: socket.socket, epoch: int) -> None:
+        try:
+            while True:
+                frame = p.recv_frame(sock)
+                if frame is None:
+                    break
+                if not (isinstance(frame, tuple) and frame[0] == "data"):
+                    continue
+                _tag, f_epoch, channel, tick, src, dst, payload = frame
+                # delivery is keyed by the FRAME's epoch: a stale frame can
+                # only land in a dead slot, never complete a current exchange
+                self.inbox.deliver(f_epoch, dst, channel, tick, src, payload)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            with self._lock:
+                still_current = self.epoch == epoch and self._conns.get(peer) is sock
+            if still_current:
+                self.inbox.fail(f"connection to shard process {peer} lost")
+
+    def exchange(
+        self,
+        worker: int,
+        channel,
+        tick: int,
+        parts: list,
+        timeout: float = 300.0,
+    ) -> list:
+        """One worker's participation in one exchange: send `parts[d]` to
+        every worker d (None = empty punctuation), then block until all
+        workers' parts for (channel, tick) addressed to `worker` arrived.
+        Returns the received parts ordered by source worker."""
+        n = self.n_workers
+        assert len(parts) == n, f"need {n} parts, got {len(parts)}"
+        epoch = self.epoch
+        for dst in range(n):
+            proc = self.process_of(dst)
+            if proc == self.process_index:
+                self.inbox.deliver(epoch, dst, channel, tick, worker, parts[dst])
+                continue
+            frame = ("data", epoch, channel, tick, worker, dst, parts[dst])
+            with self._lock:
+                sock = self._conns.get(proc)
+                slock = self._send_locks.get(proc)
+            if sock is None:
+                raise MeshError(f"no connection to shard process {proc}")
+            try:
+                with slock:
+                    p.send_frame(sock, frame)
+            except (OSError, ConnectionError) as e:
+                self.inbox.fail(f"send to shard process {proc} failed: {e}")
+                raise MeshError(str(e))
+        return self.inbox.collect(epoch, worker, channel, tick, n, timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._srv.close()
+            except OSError:
+                pass
+            for sock in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+            for conns in self._pending.values():
+                for sock in conns.values():
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+            self._pending.clear()

@@ -8,8 +8,10 @@ processes; a proto codec slots in for cross-version deployments).
 
 Commands:  CreateInstance, CreateDataflow, AllowCompaction, Peek, ProcessTo,
            Hello (epoch handshake — stale generations are fenced, the
-           communication.rs:253 epoch-fencing analogue)
-Responses: Frontiers, PeekResponse, Error, Pong
+           communication.rs:253 epoch-fencing analogue),
+           FormMesh (sharded data plane: join the epoch-fenced worker mesh
+           as one shard process of a multi-process replica, cluster/mesh.py)
+Responses: Frontiers, PeekResponse, Error, Pong, MeshReady
 """
 
 from __future__ import annotations
@@ -105,6 +107,22 @@ class Ping:
     pass
 
 
+@dataclass(frozen=True)
+class FormMesh:
+    """(Re)form the sharded worker mesh at `epoch`: this process hosts
+    `workers_per_process` workers as shard `process_index` of `n_processes`.
+    Existing dataflow state is dropped (the controller replays its command
+    history afterwards, rebuilding every shard's partition together) and any
+    in-flight exchange batches from older epochs are fenced off — a batch
+    never splits across epochs."""
+
+    epoch: int
+    process_index: int
+    n_processes: int
+    workers_per_process: int
+    peer_mesh_addrs: tuple  # ((host, port), ...) indexed by process
+
+
 # -- responses --------------------------------------------------------------
 
 
@@ -128,3 +146,9 @@ class CommandErr:
 @dataclass(frozen=True)
 class Pong:
     epoch: int
+
+
+@dataclass(frozen=True)
+class MeshReady:
+    epoch: int
+    n_workers: int

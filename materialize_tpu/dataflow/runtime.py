@@ -37,6 +37,45 @@ ERR_DTYPES = (np.dtype(np.int64),)
 Delta = Optional[tuple[Optional[UpdateBatch], Optional[UpdateBatch]]]
 
 
+class ShardContext:
+    """One worker's view of a sharded dataflow (cluster/mesh.py data plane).
+
+    When a replica runs as N processes × W workers, every worker renders the
+    SAME DataflowDescription with a ShardContext; channel ids are allocated
+    in render order, so identical rendering on every worker yields identical
+    channel numbering — the deterministic-channel discipline of timely's
+    exchange pact allocation. `exchange` is the network-boundary analogue of
+    parallel/exchange.py's device all_to_all: host-staged, hash-partitioned
+    by the routing columns' values (parallel/netexchange.py), delivered over
+    the epoch-fenced WorkerMesh.
+    """
+
+    def __init__(self, mesh, dataflow_id: str, worker: int, n_workers: int):
+        self.mesh = mesh
+        self.dataflow_id = dataflow_id
+        self.worker = worker
+        self.n_workers = n_workers
+        self._next_channel = 0
+
+    def alloc_channel(self):
+        c = self._next_channel
+        self._next_channel += 1
+        return (self.dataflow_id, c)
+
+    def exchange(
+        self, channel, tick: int, batch: Optional[UpdateBatch], key_cols
+    ) -> Optional[UpdateBatch]:
+        """Route `batch`'s live rows by hash of `key_cols` (None = whole row,
+        () = keyless → worker 0); blocks until every peer's part for this
+        (channel, tick) arrived — the per-channel progress accounting that
+        makes closing a timestamp safe."""
+        from ..parallel.netexchange import merge_parts, partition_batch
+
+        parts = partition_batch(batch, key_cols, self.n_workers)
+        received = self.mesh.exchange(self.worker, channel, tick, parts)
+        return merge_parts(received)
+
+
 def _union(parts: list[UpdateBatch]) -> Optional[UpdateBatch]:
     parts = [p for p in parts if p is not None]
     if not parts:
@@ -71,11 +110,35 @@ class Node:
         return []
 
 
+class ExchangeNode(Node):
+    """Cross-worker exchange pact in front of a stateful operator.
+
+    Participates in the shuffle EVERY tick — even with no local input, peers
+    may be sending rows this worker owns, and the punctuation (empty part)
+    this worker contributes is what lets peers close the timestamp. Errors
+    stay local: the error collection is a union across workers at peek time.
+    """
+
+    def __init__(self, shard: ShardContext, channel, key_cols):
+        self.shard = shard
+        self.channel = channel
+        self.key_cols = key_cols
+
+    def step(self, tick, ins):
+        d = ins[0]
+        oks = d[0] if d is not None else None
+        errs = d[1] if d is not None else None
+        out = self.shard.exchange(self.channel, tick, oks, self.key_cols)
+        if out is None and errs is None:
+            return None
+        return out, errs
+
+
 class ConstantNode(Node):
-    def __init__(self, expr: lir.Constant):
-        self.rows = expr.rows
+    def __init__(self, expr: lir.Constant, emit: bool = True):
+        self.rows = expr.rows if emit else ()
         self.dtypes = expr.dtypes
-        self.emitted = False
+        self.emitted = not emit
 
     def step(self, tick, ins):
         if self.emitted:
@@ -175,9 +238,19 @@ class LinearJoinNode(Node):
     """Binary join chain; each stage keeps arrangements of both sides
     (the differential `join_core` shape, linear_join.rs)."""
 
-    def __init__(self, jplan: lir.LinearJoinPlan, closure):
+    def __init__(self, jplan: lir.LinearJoinPlan, closure, shard=None):
         self.stages = jplan.stages
         self.closure = closure
+        self.shard = shard
+        # sharded: both sides of every stage exchange by the stage's join key
+        # before touching state, so matching rows co-locate (the pact.rs
+        # key-hash discipline at the process boundary). Channel allocation
+        # happens here, in render order — identical on every worker.
+        self.channels = (
+            [(shard.alloc_channel(), shard.alloc_channel()) for _ in self.stages]
+            if shard is not None
+            else None
+        )
         self.state: list[tuple[Arrangement, Arrangement]] = [
             (Arrangement(key_cols=s.stream_key), Arrangement(key_cols=s.lookup_key))
             for s in self.stages
@@ -206,6 +279,14 @@ class LinearJoinNode(Node):
         stream = ins[0][0] if ins[0] is not None else None
         for i in range(len(self.stages)):
             right = ins[i + 1][0] if ins[i + 1] is not None else None
+            if self.shard is not None:
+                st = self.stages[i]
+                stream = self.shard.exchange(
+                    self.channels[i][0], tick, stream, st.stream_key
+                )
+                right = self.shard.exchange(
+                    self.channels[i][1], tick, right, st.lookup_key
+                )
             stream = self._binary(i, stream, right)
         if stream is None and errs is None:
             return None
@@ -237,35 +318,61 @@ class DeltaJoinNode(Node):
     decomposition that half_join realizes with per-update time comparison.
     """
 
-    def __init__(self, jplan: lir.DeltaJoinPlan, closure, n_inputs: int):
+    def __init__(self, jplan: lir.DeltaJoinPlan, closure, n_inputs: int, shard=None):
         self.plan = jplan
         self.closure = closure
+        self.shard = shard
         self.arrs: dict[tuple[int, tuple[int, ...]], Arrangement] = {}
         for path in jplan.paths:
             for st in path:
                 key = (st.other_input, st.lookup_key)
                 if key not in self.arrs:
                     self.arrs[key] = Arrangement(key_cols=st.lookup_key)
+        if shard is not None:
+            # one channel per half-join hop (the stream re-keys at every
+            # stage) plus one per arrangement publish; allocation order is
+            # plan order, identical on every worker
+            self.path_channels = [
+                [shard.alloc_channel() for _ in path] for path in jplan.paths
+            ]
+            self.arr_channels = {key: shard.alloc_channel() for key in self.arrs}
 
     def step(self, tick, ins):
         errs = _union([d[1] for d in ins if d is not None])
         outs = []
+        sharded = self.shard is not None
         for k, path in enumerate(self.plan.paths):
             dk = ins[k][0] if ins[k] is not None else None
             stream = dk
-            for st in path:
-                if stream is None:
+            for si, st in enumerate(path):
+                if sharded:
+                    # every worker participates in every hop's exchange —
+                    # a worker with no local stream rows still punctuates
+                    stream = self.shard.exchange(
+                        self.path_channels[k][si], tick, stream, st.stream_key
+                    )
+                elif stream is None:
                     break
+                if stream is None:
+                    continue
                 probe = arrange_batch(stream, st.stream_key)
                 arr = self.arrs[(st.other_input, st.lookup_key)]
                 stream = _union(join_against(probe, arr.batches))
             if stream is not None:
                 outs.append(_project(stream, self.plan.permutations[k]))
-            # now publish input k's delta to its arrangements
-            if dk is not None:
-                for (inp, key), arr in self.arrs.items():
-                    if inp == k:
-                        arr.insert(arrange_batch(dk, key), already_keyed=True)
+            # now publish input k's delta to its arrangements (sharded: the
+            # delta is exchanged by each arrangement's key first, so every
+            # partitioned arrangement holds exactly the rows it owns)
+            for (inp, key), arr in self.arrs.items():
+                if inp != k:
+                    continue
+                routed = dk
+                if sharded:
+                    routed = self.shard.exchange(
+                        self.arr_channels[(inp, key)], tick, dk, key
+                    )
+                if routed is not None:
+                    arr.insert(arrange_batch(routed, key), already_keyed=True)
         out = _union(outs)
         if out is None and errs is None:
             return None
@@ -864,12 +971,20 @@ class LetRecNode(Node):
         return out, errs
 
 
+def peek_row_key(row: tuple) -> tuple:
+    """THE canonical peek output order (NULLs last per column). Every reader
+    that merges or re-sorts peek rows — materialize_counts here, the sharded
+    controller's cross-shard merge — must share this key, or sharded results
+    drift from the 1-process byte-identical contract."""
+    return tuple((v is None, 0 if v is None else v) for v in row)
+
+
 def materialize_counts(acc: dict, label: str) -> list[tuple]:
     """Expand {row: multiplicity} into sorted rows; negative multiplicities
     mean upstream inconsistency and error (the reference surfaces these as
     'Invalid data in source, saw retractions' rather than masking)."""
     rows: list[tuple] = []
-    key = lambda kv: tuple((v is None, 0 if v is None else v) for v in kv[0])
+    key = lambda kv: peek_row_key(kv[0])
     for data, cnt in sorted(acc.items(), key=key):
         if cnt < 0:
             raise RuntimeError(
@@ -928,7 +1043,11 @@ class Dataflow:
     through the operator DAG in dependency order, update exported traces.
     """
 
-    def __init__(self, desc: lir.DataflowDescription):
+    def __init__(self, desc: lir.DataflowDescription, shard: ShardContext | None = None):
+        # `shard`: render as ONE worker of a multi-process sharded replica —
+        # exchange pacts are inserted in front of every stateful operator and
+        # all workers must step the same tick sequence (see cluster/mesh.py)
+        self.shard = shard
         self.desc = desc
         self.has_temporal = False  # temporal filters need stepping every tick
         self.builds: list = []  # (obj_id, [(node, input_refs)], out_ref)
@@ -1031,12 +1150,25 @@ class Dataflow:
         self._memo[memo_key] = ref
         return ref
 
+    def _exchanged(self, ref, key_cols, ops: list):
+        """In sharded mode, interpose an exchange pact routing by `key_cols`
+        (None = whole row) so the downstream stateful operator only ever sees
+        the rows its worker owns; identity in single-worker mode."""
+        if self.shard is None:
+            return ref
+        node = ExchangeNode(self.shard, self.shard.alloc_channel(), key_cols)
+        ops.append((node, [ref]))
+        return len(ops) - 1
+
     def _render_new(self, expr, ops: list):
         e = expr
         if isinstance(e, lir.Get):
             return e.id
         if isinstance(e, lir.Constant):
-            ops.append((ConstantNode(e), []))
+            # sharded: exactly one worker emits a literal collection (rows
+            # would otherwise be duplicated n_workers times)
+            emit = self.shard is None or self.shard.worker == 0
+            ops.append((ConstantNode(e, emit=emit), []))
             return len(ops) - 1
         if isinstance(e, lir.Mfp):
             ref = self._render(e.input, ops)
@@ -1052,14 +1184,17 @@ class Dataflow:
             return len(ops) - 1
         if isinstance(e, lir.ArrangeBy):
             ref = self._render(e.input, ops)
+            ref = self._exchanged(ref, e.key_cols, ops)
             ops.append((ArrangeByNode(e.key_cols), [ref]))
             return len(ops) - 1
         if isinstance(e, lir.Join):
             refs = [self._render(i, ops) for i in e.inputs]
             if isinstance(e.plan, lir.LinearJoinPlan):
-                ops.append((LinearJoinNode(e.plan, e.closure), refs))
+                ops.append((LinearJoinNode(e.plan, e.closure, shard=self.shard), refs))
             else:
-                ops.append((DeltaJoinNode(e.plan, e.closure, len(refs)), refs))
+                ops.append(
+                    (DeltaJoinNode(e.plan, e.closure, len(refs), shard=self.shard), refs)
+                )
             return len(ops) - 1
         if isinstance(e, lir.Reduce):
             from ..expr.scalar import expr_has_dictfunc
@@ -1067,6 +1202,9 @@ class Dataflow:
             in_dt = self._infer_dtypes(e.input)
             if (
                 not e.distinct
+                # sharded: keep the MFP separate so the exchange can route
+                # on the reduce's key columns (which index the MFP's output)
+                and self.shard is None
                 and isinstance(e.input, lir.Mfp)
                 and all(a.func in ("sum", "count") for a in e.aggs)
                 # string-function MFPs need host tables: keep the MFP as its
@@ -1082,6 +1220,7 @@ class Dataflow:
                 ops.append((FusedMfpReduceNode(e.input.mfp, e, in_dt), [ref]))
                 return len(ops) - 1
             ref = self._render(e.input, ops)
+            ref = self._exchanged(ref, e.key_cols, ops)
             if e.distinct:
                 ops.append((DistinctNode(e.key_cols, in_dt), [ref]))
             else:
@@ -1089,14 +1228,17 @@ class Dataflow:
             return len(ops) - 1
         if isinstance(e, lir.BasicAgg):
             ref = self._render(e.input, ops)
+            ref = self._exchanged(ref, e.key_cols, ops)
             ops.append((BasicAggNode(e, self._infer_dtypes(e.input)), [ref]))
             return len(ops) - 1
         if isinstance(e, lir.Threshold):
             ref = self._render(e.input, ops)
+            ref = self._exchanged(ref, None, ops)  # co-locate by whole row
             ops.append((ThresholdNode(self._infer_dtypes(e.input)), [ref]))
             return len(ops) - 1
         if isinstance(e, lir.TopK):
             ref = self._render(e.input, ops)
+            ref = self._exchanged(ref, e.plan.group_cols, ops)
             if getattr(e, "monotonic", False) and e.plan.limit is not None:
                 ops.append((MonotonicTopKNode(e.plan), [ref]))
             else:
@@ -1104,9 +1246,16 @@ class Dataflow:
             return len(ops) - 1
         if isinstance(e, lir.Window):
             ref = self._render(e.input, ops)
+            ref = self._exchanged(ref, e.plan.partition_cols, ops)
             ops.append((WindowNode(e.plan), [ref]))
             return len(ops) - 1
         if isinstance(e, lir.LetRec):
+            if self.shard is not None:
+                # the inner fixpoint would need its own iteration-coordinate
+                # channels; out of scope for the v1 sharded plane
+                raise NotImplementedError(
+                    "WITH MUTUALLY RECURSIVE is not supported on sharded replicas"
+                )
             ops.append((LetRecNode(e), list(e.external_ids)))
             return len(ops) - 1
         if isinstance(e, lir.TemporalFilter):

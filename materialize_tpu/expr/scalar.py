@@ -392,10 +392,15 @@ def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
     if isinstance(expr, DictFunc):
         parts = [eval_expr3(a, cols, n) for a in expr.args]
         vals = [p[0] for p in parts]
+        # concat_ws skips NULL arguments instead of propagating them (pg
+        # semantics: no phantom separators); only a NULL separator (arg 0)
+        # nulls the result. Everything else is strictly NULL-propagating.
+        skips_null_args = expr.spec[0] == "concat_ws"
         null = parts[0][1]
         err = parts[0][2]
         for _, nv, ev in parts[1:]:
-            null = null | nv
+            if not skips_null_args:
+                null = null | nv
             err = jnp.maximum(err, ev)
         err = jnp.where(null, 0, err)
         import jax.core as _core
@@ -423,6 +428,9 @@ def eval_expr3(expr: ScalarExpr, cols: list[jnp.ndarray], n: int):
                 expr.argtypes,
                 [np.asarray(v) for v in vals],
                 np.asarray(null),
+                arg_nulls=(
+                    [np.asarray(p[1]) for p in parts] if skips_null_args else None
+                ),
             )
             out = jnp.asarray(res)
             err = jnp.where(

@@ -321,8 +321,12 @@ class StringFuncTables:
         if f == "concat":
             return "".join(args)
         if f == "concat_ws":
+            # pg: NULL args are skipped entirely (no phantom separators);
+            # a NULL separator makes the whole result NULL
             sep = args[0]
-            return sep.join(a for a in args[1:])
+            if sep is None:
+                return None
+            return sep.join(a for a in args[1:] if a is not None)
         if f == "like_dyn":
             s, pat = args[0], args[1]
             flags = (re.IGNORECASE | re.DOTALL) if spec[1] else re.DOTALL
@@ -343,11 +347,22 @@ class StringFuncTables:
             return args[0].endswith(args[1])
         return str_func_one(spec, args[0])
 
-    def eval_multi(self, spec: tuple, argtypes: tuple, cols: list[np.ndarray], nulls):
+    def eval_multi(
+        self,
+        spec: tuple,
+        argtypes: tuple,
+        cols: list[np.ndarray],
+        nulls,
+        arg_nulls=None,
+    ):
         """Vectorized host evaluation for multi-string-arg functions.
 
         `cols` are encoded value columns (codes for "str" argtypes), `nulls`
-        a bool mask of rows where any arg is NULL (skipped). Returns
+        a bool mask of rows where the RESULT is NULL (skipped). For strictly
+        NULL-propagating functions that is "any arg NULL"; null-skipping
+        functions (concat_ws) instead pass `arg_nulls` — one bool mask per
+        argument — and NULL args reach `eval_one` as Python None (to be
+        skipped), with only the separator's nullness in `nulls`. Returns
         (encoded result column, oob mask): rows whose string codes fall
         outside the dictionary (padding slots in a fixed-capacity batch, or
         corrupt data) get a zero result and a set oob bit — the caller turns
@@ -363,19 +378,41 @@ class StringFuncTables:
         oob = np.zeros((n,), dtype=bool)
         nulls = np.asarray(nulls)
         ndict = len(self.dct)
-        for at, c in zip(argtypes, cols):
+        for i, (at, c) in enumerate(zip(argtypes, cols)):
             if at in ("str", "jsonb"):
-                oob |= ~nulls & ((np.asarray(c) < 0) | (np.asarray(c) >= ndict))
+                bad = ~nulls & ((np.asarray(c) < 0) | (np.asarray(c) >= ndict))
+                if arg_nulls is not None:
+                    # a NULL arg's code is unspecified storage, not corrupt
+                    bad &= ~np.asarray(arg_nulls[i])
+                oob |= bad
         todo = ~nulls & ~oob
         if not todo.any():
             return out, oob
-        stacked = np.stack([np.asarray(c)[todo] for c in cols], axis=1)
+        nargs = len(cols)
+        if arg_nulls is None:
+            stacked = np.stack([np.asarray(c)[todo] for c in cols], axis=1)
+        else:
+            # zero NULL args' (unspecified) values so combos dedupe cleanly,
+            # and carry per-arg nullness as extra combo columns
+            stacked = np.stack(
+                [
+                    np.where(np.asarray(an)[todo], 0, np.asarray(c)[todo])
+                    for an, c in zip(arg_nulls, cols)
+                ]
+                + [np.asarray(an)[todo].astype(np.int64) for an in arg_nulls],
+                axis=1,
+            )
         combos, inv = np.unique(stacked, axis=0, return_inverse=True)
         from .scalar import NULL_I64
 
         results = np.zeros((len(combos),), dtype=dt)
         for j, combo in enumerate(combos):
-            args = [self._decode_arg(at, v) for at, v in zip(argtypes, combo)]
+            args = [
+                None
+                if arg_nulls is not None and combo[nargs + i]
+                else self._decode_arg(at, combo[i])
+                for i, at in enumerate(argtypes)
+            ]
             r = self.eval_one(spec, args)
             if r is None:
                 results[j] = NULL_I64 if kind != "bool" else 0
@@ -413,7 +450,17 @@ def decode_storage_value(argtype, v, dct, bool_style: str = "word"):
             return "t" if v else "f"
         return "true" if v else "false"
     if argtype == "float":
-        return repr(float(np.float32(v)))
+        f = np.float32(v)
+        if not np.isfinite(f):
+            return repr(float(f))  # 'inf' / '-inf' / 'nan'
+        # shortest round-trip text of the FLOAT32 value: '0.1', not the
+        # f64-repr of the widened value ('0.10000000149011612'); extreme
+        # magnitudes switch to scientific notation (pg prints 1e+30, not a
+        # 31-digit positional string)
+        a = abs(float(f))
+        if a != 0.0 and not (1e-4 <= a < 1e16):
+            return np.format_float_scientific(f, unique=True, trim="-")
+        return np.format_float_positional(f, unique=True, trim="0")
     if argtype == "int":
         return str(int(v))
     if argtype == "raw":  # already a Python value (host interpreter)

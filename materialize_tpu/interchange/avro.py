@@ -209,13 +209,20 @@ _SYNC = b"\x9aTPUavroSYNCmark"  # any 16 bytes
 
 
 class OcfWriter:
-    """Append-only OCF writer (null codec) — one block per flush."""
+    """Append-only OCF writer (null codec) — one block per flush.
+
+    Appending to an EXISTING container reuses the file's own sync marker
+    (every writer invents its own 16 bytes, so foreign-written files — avro
+    CLI, fastavro — would otherwise become untailable: readers resync on the
+    header's marker and would reject our blocks) and verifies the schema
+    matches before interleaving blocks."""
 
     def __init__(self, path: str, schema: dict):
         self.path = path
         self.schema = schema
         self._pending: list = []
         if not os.path.exists(path) or os.path.getsize(path) == 0:
+            self._sync = _SYNC
             buf = io.BytesIO()
             buf.write(_MAGIC)
             meta = {
@@ -230,6 +237,14 @@ class OcfWriter:
             buf.write(_SYNC)
             with open(path, "wb") as f:
                 f.write(buf.getvalue())
+        else:
+            existing_schema, sync, _end = read_ocf_header(path)
+            if existing_schema != schema:
+                raise ValueError(
+                    f"schema mismatch appending to {path}: file has "
+                    f"{existing_schema!r}, writer has {schema!r}"
+                )
+            self._sync = sync
 
     def append(self, record: dict) -> None:
         self._pending.append(record)
@@ -244,7 +259,7 @@ class OcfWriter:
         write_long(block, len(self._pending))
         write_long(block, len(payload.getvalue()))
         block.write(payload.getvalue())
-        block.write(_SYNC)
+        block.write(self._sync)
         with open(self.path, "ab") as f:
             f.write(block.getvalue())
         self._pending = []

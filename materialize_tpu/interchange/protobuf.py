@@ -4,14 +4,21 @@ The analogue of the reference's protobuf interchange
 (src/interchange/src/protobuf.rs, which resolves compiled descriptors). No
 generated code: a message is described as {field_number: (name, type)} with
 type in {"int64","sint64","bool","string","bytes","double","float",
-"message:<sub>"} and decoding follows the proto3 wire format (varint,
-64-bit, length-delimited, 32-bit). Unknown fields are skipped, proto3
-implicit defaults apply, repeated scalar packing is accepted for varints.
+"message:<sub>"}, optionally prefixed "repeated " — and decoding follows the
+proto3 wire format (varint, 64-bit, length-delimited, 32-bit). Unknown
+fields are skipped and proto3 implicit defaults apply. Singular fields are
+last-wins (per spec); repeated fields accumulate into a list, accepting both
+the unpacked encoding (one tagged element per occurrence) and — for scalar
+numerics — the packed encoding (one length-delimited payload holding the
+concatenated elements, proto3's default for repeated scalars).
 """
 
 from __future__ import annotations
 
 import struct
+
+_PACKABLE_VARINT = ("int64", "sint64", "bool")
+_PACKABLE_FIXED = {"double": 8, "float": 4}
 
 
 def _read_varint(data: bytes, i: int) -> tuple[int, int]:
@@ -27,6 +34,26 @@ def _read_varint(data: bytes, i: int) -> tuple[int, int]:
         shift += 7
         if shift > 70:
             raise ValueError("varint too long")
+
+
+def _unpack_payload(payload: bytes, typ: str, registry: dict) -> list:
+    """Decode a packed repeated-scalar payload: the length-delimited bytes
+    are the elements back to back with no tags."""
+    out = []
+    if typ in _PACKABLE_VARINT:
+        i = 0
+        while i < len(payload):
+            raw, i = _read_varint(payload, i)
+            out.append(_convert(raw, typ, registry))
+        return out
+    width = _PACKABLE_FIXED.get(typ)
+    if width is None:
+        raise ValueError(f"proto type {typ!r} cannot be packed")
+    if len(payload) % width:
+        raise EOFError(f"truncated packed {typ} payload")
+    for i in range(0, len(payload), width):
+        out.append(_convert(payload[i : i + width], typ, registry))
+    return out
 
 
 def decode_message(data: bytes, desc: dict, registry: dict | None = None) -> dict:
@@ -60,7 +87,18 @@ def decode_message(data: bytes, desc: dict, registry: dict | None = None) -> dic
         if spec is None:
             continue  # unknown field: skipped, per proto3
         name, typ = spec
-        out[name] = _convert(payload, typ, registry)
+        if typ.startswith("repeated "):
+            el_typ = typ[len("repeated ") :]
+            bucket = out.setdefault(name, [])
+            scalar_packable = (
+                el_typ in _PACKABLE_VARINT or el_typ in _PACKABLE_FIXED
+            )
+            if wire == 2 and scalar_packable:
+                bucket.extend(_unpack_payload(payload, el_typ, registry))
+            else:
+                bucket.append(_convert(payload, el_typ, registry))
+        else:
+            out[name] = _convert(payload, typ, registry)  # singular: last-wins
     return out
 
 
@@ -88,7 +126,9 @@ def _convert(payload, typ: str, registry: dict):
 
 
 def encode_message(values: dict, desc: dict, registry: dict | None = None) -> bytes:
-    """Inverse of decode_message (tests + fixtures)."""
+    """Inverse of decode_message (tests + fixtures). Repeated scalar numerics
+    emit the packed encoding (proto3 default); repeated strings/bytes/
+    messages emit one tagged element per occurrence."""
     registry = registry or {}
     out = bytearray()
 
@@ -104,26 +144,46 @@ def encode_message(values: dict, desc: dict, registry: dict | None = None) -> by
                 b.append(piece)
                 return bytes(b)
 
+    def scalar_payload(typ: str, v) -> bytes:
+        """Untagged wire bytes of one packable scalar — the single source of
+        truth shared by the tagged and packed encodings."""
+        if typ == "int64":
+            return varint(v)
+        if typ == "sint64":
+            return varint((v << 1) ^ (v >> 63))
+        if typ == "bool":
+            return varint(1 if v else 0)
+        if typ == "double":
+            return struct.pack("<d", v)
+        if typ == "float":
+            return struct.pack("<f", v)
+        raise ValueError(f"proto type {typ!r} is not a packable scalar")
+
+    _WIRE = {"int64": 0, "sint64": 0, "bool": 0, "double": 1, "float": 5}
+
+    def encode_one(field: int, typ: str, v) -> bytes:
+        if typ in _WIRE:
+            return varint(field << 3 | _WIRE[typ]) + scalar_payload(typ, v)
+        if typ in ("string", "bytes"):
+            raw = v.encode() if isinstance(v, str) else bytes(v)
+            return varint(field << 3 | 2) + varint(len(raw)) + raw
+        if typ.startswith("message:"):
+            sub = encode_message(v, registry[typ.split(":", 1)[1]], registry)
+            return varint(field << 3 | 2) + varint(len(sub)) + sub
+        raise ValueError(f"unsupported proto type {typ!r}")
+
     for field, (name, typ) in sorted(desc.items()):
         if name not in values or values[name] is None:
             continue
         v = values[name]
-        if typ == "int64":
-            out += varint(field << 3 | 0) + varint(v)
-        elif typ == "sint64":
-            out += varint(field << 3 | 0) + varint((v << 1) ^ (v >> 63))
-        elif typ == "bool":
-            out += varint(field << 3 | 0) + varint(1 if v else 0)
-        elif typ in ("string", "bytes"):
-            raw = v.encode() if isinstance(v, str) else bytes(v)
-            out += varint(field << 3 | 2) + varint(len(raw)) + raw
-        elif typ == "double":
-            out += varint(field << 3 | 1) + struct.pack("<d", v)
-        elif typ == "float":
-            out += varint(field << 3 | 5) + struct.pack("<f", v)
-        elif typ.startswith("message:"):
-            sub = encode_message(v, registry[typ.split(":", 1)[1]], registry)
-            out += varint(field << 3 | 2) + varint(len(sub)) + sub
+        if typ.startswith("repeated "):
+            el_typ = typ[len("repeated ") :]
+            if el_typ in _WIRE:
+                payload = b"".join(scalar_payload(el_typ, e) for e in v)
+                out += varint(field << 3 | 2) + varint(len(payload)) + payload
+            else:
+                for e in v:
+                    out += encode_one(field, el_typ, e)
         else:
-            raise ValueError(f"unsupported proto type {typ!r}")
+            out += encode_one(field, typ, v)
     return bytes(out)

@@ -40,12 +40,28 @@ class Service:
     name: str
     processes: list = field(default_factory=list)  # subprocess.Popen
     ports: list = field(default_factory=list)
+    mesh_ports: list = field(default_factory=list)  # [] for plain replicas
+    workers_per_process: int = 1
 
 
 class ProcessOrchestrator:
     def __init__(self, cpu: bool = True):
         self.services: dict[str, Service] = {}
         self.cpu = cpu
+
+    def _spawn(self, port: int, mesh_port: int | None):
+        args = [
+            sys.executable,
+            "-m",
+            "materialize_tpu.cluster.clusterd",
+            "--port",
+            str(port),
+        ]
+        if mesh_port is not None:
+            args += ["--mesh-port", str(mesh_port)]
+        if self.cpu:
+            args.append("--cpu")
+        return subprocess.Popen(args, env=_replica_env(self.cpu))
 
     def ensure_service(self, name: str, scale: int = 1) -> list[tuple]:
         """Start (or resize to) `scale` clusterd replicas; returns addresses."""
@@ -55,17 +71,7 @@ class ProcessOrchestrator:
             self.services[name] = svc
         while len(svc.processes) < scale:
             port = _free_port()
-            args = [
-                sys.executable,
-                "-m",
-                "materialize_tpu.cluster.clusterd",
-                "--port",
-                str(port),
-            ]
-            if self.cpu:
-                args.append("--cpu")
-            proc = subprocess.Popen(args, env=_replica_env(self.cpu))
-            svc.processes.append(proc)
+            svc.processes.append(self._spawn(port, None))
             svc.ports.append(port)
         while len(svc.processes) > scale:
             proc = svc.processes.pop()
@@ -73,6 +79,44 @@ class ProcessOrchestrator:
             proc.terminate()
         self._await_ready(svc)
         return [("127.0.0.1", port) for port in svc.ports]
+
+    def ensure_sharded_service(
+        self, name: str, processes: int, workers_per_process: int = 1
+    ) -> tuple[list, list]:
+        """Start a SHARD SET: `processes` clusterd processes that together
+        host one replica of `processes × workers_per_process` workers
+        (cluster/mesh.py). Returns (command addrs, mesh addrs), both indexed
+        by process — feed them to ShardedComputeController, which forms the
+        mesh and owns the epoch."""
+        svc = self.services.get(name)
+        if svc is None:
+            svc = Service(name, workers_per_process=workers_per_process)
+            self.services[name] = svc
+        elif (
+            svc.workers_per_process != workers_per_process
+            or len(svc.mesh_ports) != len(svc.processes)
+            or len(svc.processes) > processes
+        ):
+            # an existing service of a DIFFERENT shape (plain replicas
+            # without mesh listeners, another worker split, or more
+            # processes) cannot be quietly reused as this shard set
+            raise ValueError(
+                f"service {name!r} exists with an incompatible shape: "
+                f"{len(svc.processes)} processes × {svc.workers_per_process} "
+                f"workers, {len(svc.mesh_ports)} mesh listeners; wanted "
+                f"{processes} × {workers_per_process}"
+            )
+        while len(svc.processes) < processes:
+            port = _free_port()
+            mesh_port = _free_port()
+            svc.processes.append(self._spawn(port, mesh_port))
+            svc.ports.append(port)
+            svc.mesh_ports.append(mesh_port)
+        self._await_ready(svc)
+        return (
+            [("127.0.0.1", port) for port in svc.ports],
+            [("127.0.0.1", port) for port in svc.mesh_ports],
+        )
 
     def _await_ready(self, svc: Service, timeout: float = 30.0) -> None:
         deadline = time.time() + timeout
@@ -96,16 +140,8 @@ class ProcessOrchestrator:
     def restart_replica(self, name: str, idx: int) -> None:
         svc = self.services[name]
         port = svc.ports[idx]
-        args = [
-            sys.executable,
-            "-m",
-            "materialize_tpu.cluster.clusterd",
-            "--port",
-            str(port),
-        ]
-        if self.cpu:
-            args.append("--cpu")
-        svc.processes[idx] = subprocess.Popen(args, env=_replica_env(self.cpu))
+        mesh_port = svc.mesh_ports[idx] if svc.mesh_ports else None
+        svc.processes[idx] = self._spawn(port, mesh_port)
         self._await_ready(svc)
 
     def drop_service(self, name: str) -> None:

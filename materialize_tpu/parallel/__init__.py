@@ -1,6 +1,7 @@
 from .exchange import exchange, route_to_buckets
 from .fused import arrangement_insert, fused_accumulable_step, fused_join_delta
 from .mesh import WORKERS, make_mesh
+from .netexchange import merge_parts, partition_batch, partition_cols
 
 __all__ = [
     "exchange",
@@ -10,4 +11,7 @@ __all__ = [
     "fused_join_delta",
     "WORKERS",
     "make_mesh",
+    "merge_parts",
+    "partition_batch",
+    "partition_cols",
 ]

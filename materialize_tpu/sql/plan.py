@@ -782,11 +782,14 @@ class Planner:
             planned = [self.plan_scalar(a, scope) for a in args]
             # pg concat treats NULL string args as ''; coalesce them so the
             # NULL-propagating DictFunc matches (non-string NULLs still
-            # propagate — documented divergence)
+            # propagate — documented divergence). concat_ws must NOT
+            # coalesce: NULL args are skipped at eval time (no phantom
+            # separators) and a NULL separator yields NULL — the eval layer
+            # handles both (expr/scalar.py concat_ws null semantics).
             empty = Literal(self.catalog.dict.encode(""))
             vals, ats = [], []
             for v, t in planned:
-                if t.col == ColType.STRING:
+                if t.col == ColType.STRING and name == "concat":
                     v = CallVariadic("coalesce", (v, empty))
                 vals.append(v)
                 ats.append(_argtype(t))

@@ -5,6 +5,8 @@ the SQL CREATE SOURCE surface with incremental tailing and an upsert
 envelope. Reference: src/interchange/src/{avro,protobuf}.rs.
 """
 
+import io
+import json
 import os
 
 import pytest
@@ -165,3 +167,83 @@ def test_protobuf_roundtrip():
     raw2 = protobuf.encode_message(msg2, desc, registry)
     got = protobuf.decode_message(raw2, {1: ("id", "int64")}, registry)
     assert got == {"id": -1}
+
+
+def test_protobuf_repeated_fields():
+    """Repeated scalars accept BOTH encodings (packed length-delimited —
+    proto3's default — and one tagged element per occurrence) and
+    accumulate instead of last-wins; singular fields stay last-wins."""
+    desc = {
+        1: ("tags", "repeated int64"),
+        2: ("names", "repeated string"),
+        3: ("weights", "repeated double"),
+        4: ("id", "int64"),
+    }
+    msg = {"tags": [3, 270, -1], "names": ["a", "bc"], "weights": [1.5, -2.0], "id": 9}
+    raw = protobuf.encode_message(msg, desc)
+    assert protobuf.decode_message(raw, desc) == msg
+
+    # unpacked spelling of the same repeated varint field: one tag per element
+    def varint(v):
+        v &= 0xFFFFFFFFFFFFFFFF
+        out = bytearray()
+        while True:
+            piece = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(piece | 0x80)
+            else:
+                out.append(piece)
+                return bytes(out)
+
+    unpacked = varint(1 << 3 | 0) + varint(3) + varint(1 << 3 | 0) + varint(270)
+    assert protobuf.decode_message(unpacked, desc) == {"tags": [3, 270]}
+    # mixed packed + unpacked occurrences concatenate in order
+    packed_tail = varint(1 << 3 | 2) + varint(2) + varint(5) + varint(6)
+    assert protobuf.decode_message(unpacked + packed_tail, desc) == {
+        "tags": [3, 270, 5, 6]
+    }
+    # singular fields remain proto3 last-wins
+    dup = varint(4 << 3 | 0) + varint(1) + varint(4 << 3 | 0) + varint(2)
+    assert protobuf.decode_message(dup, desc) == {"id": 2}
+
+
+def test_ocf_append_reuses_foreign_sync_marker(tmp_path):
+    """Appending to an OCF file written with a DIFFERENT sync marker must
+    reuse the file's own marker (readers resync on the header's marker), and
+    refuse a mismatched schema."""
+    path = str(tmp_path / "foreign.avro")
+    foreign_sync = bytes(range(16))
+    # hand-write a foreign container: header + one block, custom sync
+    buf = io.BytesIO()
+    buf.write(b"Obj\x01")
+    meta = {"avro.schema": json.dumps(SCHEMA).encode(), "avro.codec": b"null"}
+    avro.write_long(buf, len(meta))
+    for k, v in meta.items():
+        avro.encode_value("string", k, buf)
+        avro.encode_value("bytes", v, buf)
+    avro.write_long(buf, 0)
+    buf.write(foreign_sync)
+    rec = {"id": 1, "name": "a", "score": 0.5, "tags": [], "props": {}, "ok": True}
+    payload = io.BytesIO()
+    avro.encode_value(SCHEMA, rec, payload)
+    avro.write_long(buf, 1)
+    avro.write_long(buf, len(payload.getvalue()))
+    buf.write(payload.getvalue())
+    buf.write(foreign_sync)
+    with open(path, "wb") as f:
+        f.write(buf.getvalue())
+
+    w = avro.OcfWriter(path, SCHEMA)
+    assert w._sync == foreign_sync
+    rec2 = dict(rec, id=2)
+    w.append(rec2)
+    w.flush()
+    schema, sync, hdr = avro.read_ocf_header(path)
+    assert sync == foreign_sync
+    got, _off, corrupt = avro.read_blocks_from(path, hdr, schema, sync)
+    assert not corrupt
+    assert [r["id"] for r in got] == [1, 2]
+    # appending with a different schema is refused, not silently interleaved
+    with pytest.raises(ValueError, match="schema mismatch"):
+        avro.OcfWriter(path, {"type": "record", "name": "other", "fields": []})

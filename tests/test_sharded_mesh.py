@@ -1,0 +1,266 @@
+"""Multi-process sharded data plane (cluster/mesh.py).
+
+The sharded-replica test driver: a headless ShardedComputeController speaks
+CTP to REAL clusterd subprocesses that form a worker mesh, asserting
+
+* TPC-H Q3 incremental updates on a 2-process × 2-worker sharded replica are
+  byte-identical to the 1-process path (insert + delete churn),
+* per-channel progress accounting closes no timestamp early (the smoke-tier
+  in-process mesh roundtrip checks punctuation/ordering directly),
+* a killed shard process rejoins only through an epoch-fenced mesh
+  reformation + history replay, and stale-epoch peers are refused.
+"""
+
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from materialize_tpu.cluster import (
+    ComputeController,
+    ShardedComputeController,
+    WorkerMesh,
+)
+from materialize_tpu.cluster import protocol as p
+from materialize_tpu.models import auction, tpch
+from materialize_tpu.orchestrator import ProcessOrchestrator
+from materialize_tpu.persist import FileBlob, FileConsensus, ShardMachine
+
+
+def write_rows(shard, lower, ts, rows, ncols):
+    cols = {
+        f"c{i}": np.array([r[i] for r in rows], dtype=np.int64)
+        for i in range(ncols)
+    }
+    cols["times"] = np.full(len(rows), ts, dtype=np.uint64)
+    cols["diffs"] = np.array([r[ncols] for r in rows], dtype=np.int64)
+    shard.compare_and_append(cols, lower, ts + 1)
+
+
+# -- smoke tier: in-process mesh exchange roundtrip --------------------------
+
+
+@pytest.mark.smoke
+def test_mesh_exchange_roundtrip_smoke():
+    """Two WorkerMesh endpoints (2 processes × 2 workers) in one process:
+    hash-partitioned exchange delivers every row to the hash-owning worker,
+    empty parts punctuate, and collect blocks until all peers sent — the
+    fast sharded-exchange regression gate for the pre-commit smoke run."""
+    from materialize_tpu.parallel.netexchange import (
+        merge_parts,
+        partition_batch,
+        route_dests,
+    )
+    from materialize_tpu.repr.batch import UpdateBatch
+
+    m0 = WorkerMesh("127.0.0.1", 0)
+    m1 = WorkerMesh("127.0.0.1", 0)
+    addrs = [m0.addr, m1.addr]
+    t0 = threading.Thread(target=m0.form, args=(7, 0, 2, 2, addrs))
+    t0.start()
+    m1.form(7, 1, 2, 2, addrs)
+    t0.join()
+    assert m0.n_workers == 4 and m1.n_workers == 4
+
+    keys = np.arange(64, dtype=np.int64)
+    batch = UpdateBatch.build(
+        (),
+        (keys, keys * 10),
+        np.full(64, 3, dtype=np.uint64),
+        np.ones(64, dtype=np.int64),
+    )
+    # every worker contributes the same 64 rows routed by column 0
+    results: dict = {}
+
+    def run_worker(mesh, w):
+        parts = partition_batch(batch, (0,), 4)
+        got = mesh.exchange(w, ("df", 0), 3, parts)
+        results[w] = merge_parts(got)
+
+    threads = [
+        threading.Thread(target=run_worker, args=(m, w))
+        for m, ws in ((m0, (0, 1)), (m1, (2, 3)))
+        for w in ws
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    from materialize_tpu.parallel.netexchange import batch_to_cols
+
+    dests = np.asarray(route_dests(batch_to_cols(batch), (0,), 4))
+    for w in range(4):
+        own = int((dests == w).sum())
+        got = results[w]
+        if own == 0:
+            assert got is None
+            continue
+        # all 4 workers sent identical batches: 4 copies of the owned rows
+        assert got is not None and int(got.count()) == 4 * own
+        got_keys = set(np.asarray(got.to_host()["vals"][0]).tolist())
+        assert got_keys == set(keys[dests == w].tolist())
+    # progress accounting: re-closing the same (channel, tick) is a violation
+    from materialize_tpu.cluster.mesh import MeshError
+
+    with pytest.raises(MeshError, match="progress violation"):
+        for src in range(4):
+            m0.inbox.deliver(7, 0, ("df", 0), 3, src, None)
+        m0.inbox.collect(7, 0, ("df", 0), 3, 4, timeout=0.5)
+    m0.close()
+    m1.close()
+
+
+@pytest.mark.smoke
+def test_mesh_stale_epoch_refused_smoke():
+    """Epoch fencing at the mesh boundary: a peer handshaking below the
+    current epoch is refused (communication.rs:253-284)."""
+    m = WorkerMesh("127.0.0.1", 0)
+    m.form(5, 0, 1, 2, [m.addr])
+    sock = socket.create_connection(m.addr, timeout=5.0)
+    p.send_frame(sock, ("hello", 3, 1))
+    reply = p.recv_frame(sock)
+    assert reply == ("fenced", 5)
+    sock.close()
+    m.close()
+
+
+# -- real-subprocess tier ----------------------------------------------------
+
+
+@pytest.fixture
+def sharded_cluster(tmp_path):
+    orch = ProcessOrchestrator(cpu=True)
+    blob_path = str(tmp_path / "blob")
+    cas_path = str(tmp_path / "cas")
+    blob, cas = FileBlob(blob_path), FileConsensus(cas_path)
+    ctls = []
+    yield orch, blob_path, cas_path, blob, cas, ctls
+    for ctl in ctls:
+        ctl.close()
+    orch.shutdown()
+
+
+def test_sharded_q3_byte_identical_to_single_process(sharded_cluster):
+    """TPC-H Q3 deltas on 2 processes × 2 workers == the 1-process path,
+    under insert + delete churn (the BASELINE config 5 shape, satisfied by
+    real cross-process exchange instead of a single-process dryrun)."""
+    orch, blob_path, cas_path, blob, cas, ctls = sharded_cluster
+    customer = ShardMachine(blob, cas, "customer")
+    orders = ShardMachine(blob, cas, "orders")
+    lineitem = ShardMachine(blob, cas, "lineitem")
+
+    addrs, mesh_addrs = orch.ensure_sharded_service("q3", 2, workers_per_process=2)
+    ctl = ShardedComputeController(
+        addrs, mesh_addrs, 2, blob_path, cas_path, epoch=1
+    )
+    ctls.append(ctl)
+    single = ComputeController(
+        orch.ensure_service("q3_single", scale=1), blob_path, cas_path, epoch=1
+    )
+    ctls.append(single)
+
+    src = {"customer": "customer", "orders": "orders", "lineitem": "lineitem"}
+    ctl.create_dataflow("q3", tpch.q3(), src, as_of=0)
+    single.create_dataflow("q3", tpch.q3(), src, as_of=0)
+
+    B, D = tpch.BUILDING, tpch.Q3_DATE
+    # tick 1: base data — 3 building customers, orders before the date,
+    # lineitems after it, spread across join keys so every worker owns some
+    write_rows(
+        customer, 0, 1,
+        [(c, B if c % 2 else 0, 0, 1) for c in range(1, 9)],
+        3,
+    )
+    write_rows(
+        orders, 0, 1,
+        [(100 + o, (o % 8) + 1, D - 1 - (o % 3), o % 5, 1) for o in range(12)],
+        4,
+    )
+    write_rows(
+        lineitem, 0, 1,
+        [(100 + (l % 12), 1000 + l, l % 10, D + 1 + (l % 4), 1, l, 1) for l in range(40)],
+        6,
+    )
+    ctl.process_to(2)
+    single.process_to(2)
+    expected = single.peek("q3", "idx_q3")
+    got = ctl.peek("q3", "idx_q3")
+    assert got == expected
+    assert len(got) > 0
+
+    # tick 2: churn — retract a lineitem and an order, add new ones
+    write_rows(lineitem, 2, 2, [(101, 1001, 1, D + 2, 1, 1, -1),
+                                (105, 7777, 3, D + 9, 1, 9, 1)], 6)
+    write_rows(orders, 2, 2, [(103, 4, D - 1, 3, -1),
+                              (150, 5, D - 5, 2, 1)], 4)
+    write_rows(lineitem, 3, 3, [(150, 2222, 2, D + 3, 1, 3, 1)], 6)
+    ctl.process_to(4)
+    single.process_to(4)
+    expected2 = single.peek("q3", "idx_q3")
+    got2 = ctl.peek("q3", "idx_q3")
+    assert got2 == expected2
+    assert got2 != got  # the churn actually changed the result
+
+    # frontiers: min across shards reached the processed upper
+    assert ctl.frontiers() == {"q3": 4}
+
+
+def test_epoch_fenced_shard_restart(sharded_cluster):
+    """Kill one shard process of a 2-process replica: peeks fail (state is
+    PARTITIONED — no shard can answer alone), the restarted process rejoins
+    only via reform() at a bumped epoch + history replay, and results match
+    the pre-kill state plus new writes."""
+    orch, blob_path, cas_path, blob, cas, ctls = sharded_cluster
+    bids = ShardMachine(blob, cas, "bids")
+
+    addrs, mesh_addrs = orch.ensure_sharded_service("ha", 2, workers_per_process=1)
+    ctl = ShardedComputeController(
+        addrs, mesh_addrs, 1, blob_path, cas_path, epoch=1
+    )
+    ctls.append(ctl)
+    ctl.create_dataflow("df1", auction.bids_sum_count(), {"bids": "bids"}, as_of=0)
+
+    write_rows(bids, 0, 1, [(1, 7, 10, 100, 0, 1), (2, 8, 10, 250, 0, 1),
+                            (3, 7, 11, 40, 0, 1)], 5)
+    ctl.process_to(2)
+    before = ctl.peek("df1", "idx_bids_sum")
+    assert before == [(10, 350, 2), (11, 40, 1)]
+
+    orch.kill_replica("ha", 0)
+    with pytest.raises((RuntimeError, ConnectionError)):
+        ctl.peek("df1", "idx_bids_sum")
+
+    orch.restart_replica("ha", 0)
+    # the restarted process is mesh-naive until the controller reforms at a
+    # HIGHER epoch and replays history — shards rebuild their partitions
+    # together, so no batch ever spans the kill
+    old_epoch = ctl.epoch
+    ctl.reform()
+    assert ctl.epoch == old_epoch + 1
+    assert ctl.peek("df1", "idx_bids_sum") == before
+
+    # a peer trying to rejoin at the OLD epoch is fenced out of the mesh
+    sock = socket.create_connection(tuple(mesh_addrs[1]), timeout=5.0)
+    p.send_frame(sock, ("hello", old_epoch, 0))
+    reply = p.recv_frame(sock)
+    assert reply == ("fenced", ctl.epoch)
+    sock.close()
+
+    # the reformed mesh keeps processing new writes
+    write_rows(bids, 2, 2, [(4, 9, 11, 60, 0, 1)], 5)
+    ctl.process_to(3)
+    assert ctl.peek("df1", "idx_bids_sum") == [(10, 350, 2), (11, 100, 2)]
+
+
+def test_coordinator_replica_sizes(tmp_path):
+    """adapter: '2x4' parses to 2 processes × 4 workers; bad sizes error."""
+    from materialize_tpu.adapter.coordinator import parse_replica_size
+
+    assert parse_replica_size("2x4") == (2, 4)
+    assert parse_replica_size("1X2") == (1, 2)
+    assert parse_replica_size("8") == (1, 8)
+    for bad in ("0x2", "2x0", "x", "", "axb"):
+        with pytest.raises(ValueError):
+            parse_replica_size(bad)

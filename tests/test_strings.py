@@ -86,6 +86,35 @@ def test_concat_variants(coord):
     assert q(coord, "SELECT starts_with(s, 'he') FROM t WHERE a = 1") == [(True,)]
 
 
+def test_concat_ws_null_semantics(coord):
+    # pg: concat_ws SKIPS NULL args — no phantom separators (q() sorts rows)
+    assert q(coord, "SELECT concat_ws(',', s, 'z') FROM t") == [
+        ("World,z",),
+        ("hello,z",),
+        ("z",),  # NULL s is skipped entirely, not coalesced to ''
+    ]
+    assert q(coord, "SELECT concat_ws('-', 'a', s, a) FROM t WHERE a = 3") == [
+        ("a-3",)
+    ]
+    # a NULL separator yields NULL
+    assert q(coord, "SELECT concat_ws(NULL, 'a', 'b') FROM t WHERE a = 1") == [
+        (None,)
+    ]
+    # all-NULL args with a non-NULL separator: empty string, not NULL
+    assert q(coord, "SELECT concat_ws('-', s, s) FROM t WHERE a = 3") == [("",)]
+
+
+def test_float_render_shortest_roundtrip(coord):
+    # float32 renders as shortest round-trip text: '0.1', never the widened
+    # f64 repr '0.10000000149011612'
+    coord.execute("CREATE TABLE f (x real)")
+    coord.execute("INSERT INTO f VALUES (0.1), (2.5)")
+    assert q(coord, "SELECT 'v=' || x FROM f") == [("v=0.1",), ("v=2.5",)]
+    assert q(coord, "SELECT concat_ws(':', x, x) FROM f WHERE x < 1") == [
+        ("0.1:0.1",)
+    ]
+
+
 def test_string_funcs_in_incremental_mv(coord):
     coord.execute(
         "CREATE MATERIALIZED VIEW mv AS SELECT upper(s) AS u, count(*) "
