@@ -1295,7 +1295,7 @@ class Coordinator:
     # -- compute replicas ------------------------------------------------------
     def create_compute_replica(
         self, name: str, size: str, orchestrator=None, epoch: int = 1,
-        cpu: bool = True,
+        cpu: bool = True, heartbeat_interval: float | None = None,
     ):
         """Allocate a compute replica of `size` ("PxW": processes × workers)
         as real clusterd subprocesses reading this coordinator's persist
@@ -1325,10 +1325,16 @@ class Coordinator:
         owned = orchestrator is None
         if owned:
             orchestrator = ProcessOrchestrator(cpu=cpu)
+        # ship the dyncfg snapshot (frame cap, exchange deadline) and wire
+        # the self-healing loop: heartbeats detect a dead/amnesiac shard, the
+        # orchestrator restart hook brings the process back, and the
+        # controller reforms at a bumped epoch — no coordinator intervention
+        config = self.configs.snapshot()
         if processes == 1 and workers == 1:
             addrs = orchestrator.ensure_service(name, scale=1)
             ctl = ComputeController(
-                addrs, self.blob.root, self.consensus.root, epoch=epoch
+                addrs, self.blob.root, self.consensus.root, epoch=epoch,
+                config=config, heartbeat_interval=heartbeat_interval,
             )
         else:
             addrs, mesh_addrs = orchestrator.ensure_sharded_service(
@@ -1341,6 +1347,11 @@ class Coordinator:
                 self.blob.root,
                 self.consensus.root,
                 epoch=epoch,
+                config=config,
+                heartbeat_interval=heartbeat_interval,
+                restart_shard=orchestrator.restarter(name)
+                if hasattr(orchestrator, "restarter")
+                else None,
             )
         self._compute_replicas[name] = (ctl, orchestrator, owned)
         return ctl
@@ -1352,6 +1363,25 @@ class Coordinator:
         ctl.close()
         if owned:
             orchestrator.drop_service(name)
+
+    def replica_peek(self, dataflow_id: str, index_id: str, at=None):
+        """Serve a peek from ANY live compute replica (absorb_peek_response:
+        replicas are interchangeable). Graceful degradation: a replica that
+        is mid-reform (degraded) or errors is skipped, so one sharded
+        replica's recovery never blocks reads that another replica — or the
+        same replica a moment later — can answer."""
+        if not self._compute_replicas:
+            raise RuntimeError("no compute replicas")
+        last: Exception | None = None
+        for name, (ctl, _orch, _owned) in self._compute_replicas.items():
+            if getattr(ctl, "degraded", False):
+                last = RuntimeError(f"replica {name!r} degraded (reforming)")
+                continue
+            try:
+                return ctl.peek(dataflow_id, index_id, at=at)
+            except (ConnectionError, OSError, RuntimeError) as e:
+                last = e
+        raise RuntimeError(f"no replica could serve peek {index_id}: {last}")
 
     # -- external file sources -------------------------------------------------
     def _poll_file_sources(self, writes: dict, ts: int, max_records: int):

@@ -117,8 +117,25 @@ MV_SINK_SELF_CORRECT = Config(
     "the full diff is amortized over the interval)",
 )
 
+CTP_MAX_FRAME_BYTES = Config(
+    "ctp_max_frame_bytes",
+    1 << 30,
+    "reject CTP frames whose wire length header exceeds this many bytes "
+    "(a corrupt/desynced stream would otherwise loop allocating gigabytes; "
+    "shipped to clusterd in CreateInstance.config)",
+)
+MESH_EXCHANGE_TIMEOUT = Config(
+    "mesh_exchange_timeout_s",
+    300.0,
+    "per-tick deadline on sharded-mesh exchanges: a collect stalled past "
+    "this many seconds raises MeshError and drives an epoch-bumped reform "
+    "instead of hanging the shard's command loop",
+)
+
 ALL_CONFIGS = [
     MV_SINK_SELF_CORRECT,
+    CTP_MAX_FRAME_BYTES,
+    MESH_EXCHANGE_TIMEOUT,
     ENABLE_DELTA_JOIN,
     DELTA_JOIN_MAX_INPUTS,
     LSM_MERGE_RATIO,

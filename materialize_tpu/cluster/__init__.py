@@ -1,11 +1,20 @@
-from . import protocol
-from .controller import ComputeController, ReplicaClient, ShardedComputeController
+from . import faults, protocol
+from .controller import (
+    ComputeController,
+    ReplicaClient,
+    ReplicaDegraded,
+    ShardedComputeController,
+)
+from .faults import FaultPlan
 from .mesh import MeshError, WorkerMesh
 
 __all__ = [
     "protocol",
+    "faults",
+    "FaultPlan",
     "ComputeController",
     "ReplicaClient",
+    "ReplicaDegraded",
     "ShardedComputeController",
     "MeshError",
     "WorkerMesh",

@@ -123,21 +123,44 @@ class ClusterState:
     def sharded(self) -> bool:
         return bool(self.workers)
 
+    def _mesh_epoch(self) -> int:
+        """Epoch of the FORMED mesh (-1 when none): lets the controller's
+        heartbeat tell a restarted, state-less shard from a healthy one."""
+        if self.mesh is not None and self.workers:
+            return self.mesh.epoch
+        return -1
+
+    def _mesh_naive(self) -> bool:
+        """A mesh-capable process with no formed mesh (fresh start or
+        restart): it must refuse state-bearing commands — answering them in
+        whole-replica mode would silently serve an EMPTY partition."""
+        return self.mesh is not None and not self.workers
+
     # -- command handlers (compute_state.rs:516 analogue) ---------------------
     def handle(self, cmd):
         if isinstance(cmd, p.Hello):
             if cmd.epoch < self.epoch:
                 return p.CommandErr(f"fenced: stale epoch {cmd.epoch} < {self.epoch}")
             self.epoch = cmd.epoch
-            return p.Pong(self.epoch)
+            return p.Pong(self.epoch, self._mesh_epoch())
         if isinstance(cmd, p.Ping):
-            return p.Pong(self.epoch)
+            return p.Pong(self.epoch, self._mesh_epoch())
         if isinstance(cmd, p.FormMesh):
             return self._form_mesh(cmd)
         if isinstance(cmd, p.CreateInstance):
             self.blob = FileBlob(cmd.blob_path)
             self.consensus = FileConsensus(cmd.consensus_path)
+            cfg = cmd.config or {}
+            if "ctp_max_frame_bytes" in cfg:
+                p.set_max_frame_bytes(cfg["ctp_max_frame_bytes"])
             return p.Frontiers({})
+        if self._mesh_naive() and isinstance(
+            cmd, (p.CreateDataflow, p.ProcessTo, p.AllowCompaction, p.Peek)
+        ):
+            msg = "MeshError: no formed mesh at this process (restarted?) — reform required"
+            if isinstance(cmd, p.Peek):
+                return p.PeekResponse(cmd.uuid, None, msg)
+            return p.CommandErr(msg)
         if isinstance(cmd, p.CreateDataflow):
             return self._create_dataflow(cmd)
         if isinstance(cmd, p.AllowCompaction):
@@ -186,6 +209,7 @@ class ClusterState:
                 cmd.n_processes,
                 cmd.workers_per_process,
                 list(cmd.peer_mesh_addrs),
+                exchange_timeout=getattr(cmd, "exchange_timeout", None),
             )
         except MeshError as e:
             return p.CommandErr(str(e))
@@ -265,6 +289,10 @@ class ClusterState:
 
         try:
             _run_on_workers(self.workers, create)
+        except MeshError as e:
+            # a MeshError is retryable by reform; the controller keys on the
+            # prefix to drive heal+reform instead of surfacing a hard error
+            return p.CommandErr(f"MeshError: sharded create_dataflow: {e}")
         except Exception as e:
             return p.CommandErr(f"sharded create_dataflow failed: {e}")
         self.sharded_dataflows[cmd.dataflow_id] = {
@@ -360,6 +388,8 @@ class ClusterState:
 
             try:
                 _run_on_workers(self.workers, advance)
+            except MeshError as e:
+                return p.CommandErr(f"MeshError: sharded process_to: {e}")
             except Exception as e:
                 return p.CommandErr(f"sharded process_to failed: {e}")
             st["frontier"] = upper
@@ -436,15 +466,25 @@ def serve(host: str, port: int, mesh_port: int | None = None):
     srv.listen(4)
     print(f"clusterd listening on {host}:{port}", flush=True)
 
+    def ident():
+        """Fault-injection identity: known only once the mesh is formed (so
+        handshakes with a fresh/restarted process are never faulted), and
+        matching the controller's ReplicaClient label for the same link."""
+        if state.mesh is not None and state.workers:
+            return f"shard{state.mesh.process_index}"
+        return None
+
     def client(conn):
         try:
             while True:
-                cmd = p.recv_frame(conn)
+                me = ident()
+                cmd = p.recv_frame(conn, link=("ctl", me) if me else None)
                 if cmd is None:
                     break
                 with lock:
                     resp = state.handle(cmd)
-                p.send_frame(conn, resp)
+                me = ident()
+                p.send_frame(conn, resp, link=(me, "ctl") if me else None)
         except (ConnectionError, OSError):
             pass
         finally:
@@ -467,6 +507,11 @@ def main() -> None:
     )
     ap.add_argument("--cpu", action="store_true", help="force CPU jax (tests)")
     args = ap.parse_args()
+    # chaos tests: adopt the spawning process's seeded fault schedule so the
+    # shard mesh runs under the same deterministic network simulation
+    from . import faults
+
+    faults.install_from_env()
     if args.cpu:
         import os
 

@@ -19,13 +19,55 @@ followed by a full history replay.
 
 from __future__ import annotations
 
+import random
 import socket
 import threading
 import time
 import uuid as uuidlib
-from dataclasses import dataclass, field
 
 from . import protocol as p
+
+# Per-command deadlines (seconds): how long a single request may wait for its
+# response before the connection is declared dead. Tick-driving commands can
+# legitimately take minutes (first XLA compile of a dataflow); pings must
+# fail fast so liveness detection is prompt.
+DEFAULT_DEADLINES = {
+    p.Hello: 10.0,
+    p.CreateInstance: 60.0,
+    p.CreateDataflow: 900.0,
+    p.AllowCompaction: 120.0,
+    p.Peek: 120.0,
+    p.ProcessTo: 900.0,
+    p.Ping: 5.0,
+    p.FormMesh: 120.0,
+}
+
+# Commands safe to re-send after a reconnect/reform: replaying them against
+# state that already absorbed them is a no-op (ProcessTo below the frontier,
+# AllowCompaction to the same since, CreateDataflow of an installed id).
+# Peeks are NOT here — they retry under a fresh nonce so a late duplicate
+# response is discarded, never double-delivered.
+IDEMPOTENT_COMMANDS = (
+    p.CreateInstance,
+    p.CreateDataflow,
+    p.AllowCompaction,
+    p.ProcessTo,
+)
+
+
+class ReplicaDegraded(ConnectionError):
+    """The sharded replica is mid-reform; peeks should fall back to another
+    replica (Coordinator.replica_peek) instead of stalling on this one."""
+
+
+def backoff_delay(
+    attempt: int, base: float = 0.1, cap: float = 2.0, rng=None
+) -> float:
+    """Capped exponential backoff with jitter: base * 2^attempt, capped,
+    scaled by a uniform [0.5, 1.5) factor so retry storms decorrelate."""
+    d = min(cap, base * (2.0 ** attempt))
+    r = rng.random() if rng is not None else random.random()
+    return d * (0.5 + r)
 
 
 def reduce_command_history(history: list, cmd) -> list:
@@ -50,10 +92,22 @@ def reduce_command_history(history: list, cmd) -> list:
 class ReplicaClient:
     """One replica connection (controller/replica.rs analogue)."""
 
-    def __init__(self, addr: tuple, epoch: int):
+    def __init__(
+        self,
+        addr: tuple,
+        epoch: int,
+        label: str | None = None,
+        deadlines: dict | None = None,
+    ):
         self.addr = addr
         self.epoch = epoch
         self.sock: socket.socket | None = None
+        # fault-injection link label; frames ride ("ctl", label) outbound and
+        # (label, "ctl") inbound (cluster/faults.py)
+        self.label = label if label is not None else f"{addr[0]}:{addr[1]}"
+        self.deadlines = dict(DEFAULT_DEADLINES)
+        if deadlines:
+            self.deadlines.update(deadlines)
         # one in-flight request per connection: the heartbeat thread and the
         # command path share the socket (reference CTP likewise serializes
         # frames per connection, src/service/src/transport.rs)
@@ -73,19 +127,91 @@ class ReplicaClient:
                 return
             except (ConnectionError, OSError) as e:
                 last = e
+                # never leak the half-open fd across retries: the Hello may
+                # have failed AFTER the dial succeeded (CommandErr, timeout)
+                self.close()
                 time.sleep(0.05)
+        self.close()  # ... or on final failure
         raise ConnectionError(f"cannot reach replica {self.addr}: {last}")
 
-    def request(self, cmd):
+    def request(self, cmd, timeout: float | None = None):
+        """Send one command and return its response, under a per-command
+        deadline (DEFAULT_DEADLINES by type unless `timeout` overrides). A
+        missed deadline surfaces as ConnectionError — the caller closes the
+        (possibly desynced) connection and re-dials before retrying."""
+        if timeout is None:
+            timeout = self.deadlines.get(type(cmd))
         with self.lock:
             sock = self.sock
             if sock is None:
                 raise ConnectionError(f"replica {self.addr} not connected")
-            p.send_frame(sock, cmd)
-            resp = p.recv_frame(sock)
-        if resp is None:
-            raise ConnectionError(f"replica {self.addr} hung up")
-        return resp
+            try:
+                if timeout is not None:
+                    sock.settimeout(timeout)
+                p.send_frame(sock, cmd, link=("ctl", self.label))
+                while True:
+                    resp = p.recv_frame(sock, link=(self.label, "ctl"))
+                    if resp is None:
+                        raise ConnectionError(f"replica {self.addr} hung up")
+                    if isinstance(resp, p.PeekResponse) and (
+                        not isinstance(cmd, p.Peek) or resp.uuid != cmd.uuid
+                    ):
+                        # a duplicated/late PeekResponse from a retired nonce:
+                        # discard it — retried peeks carry a FRESH uuid, so a
+                        # stale answer can never be double-delivered
+                        continue
+                    if isinstance(resp, p.Pong) and not isinstance(
+                        cmd, (p.Ping, p.Hello)
+                    ):
+                        # a Pong arriving after its try_ping timed out: discard
+                        # it, or this command would consume the heartbeat's
+                        # answer and shift every later response off by one
+                        continue
+                    return resp
+            except socket.timeout as e:
+                raise ConnectionError(
+                    f"replica {self.addr}: {type(cmd).__name__} missed its "
+                    f"{timeout:.1f}s deadline"
+                ) from e
+            finally:
+                if timeout is not None and self.sock is sock:
+                    try:
+                        sock.settimeout(600.0)
+                    except OSError:
+                        pass
+
+    def try_ping(self, timeout: float = 5.0):
+        """Liveness probe that never queues behind a long in-flight command:
+        returns the Pong, "busy" if the socket is mid-command (treated as
+        alive), or None if the replica is dead/desynced."""
+        if not self.lock.acquire(timeout=0.2):
+            return "busy"
+        try:
+            sock = self.sock
+            if sock is None:
+                return None
+            try:
+                sock.settimeout(timeout)
+                p.send_frame(sock, p.Ping(), link=("ctl", self.label))
+                while True:
+                    resp = p.recv_frame(sock, link=(self.label, "ctl"))
+                    if resp is None:
+                        return None
+                    if isinstance(resp, p.Pong):
+                        return resp
+                    if isinstance(resp, p.PeekResponse):
+                        continue  # late duplicate, discard
+                    return None
+            except (ConnectionError, OSError):
+                return None
+            finally:
+                if self.sock is sock:
+                    try:
+                        sock.settimeout(600.0)
+                    except OSError:
+                        pass
+        finally:
+            self.lock.release()
 
     def close(self) -> None:
         # taking the request lock means we never close the fd out from under
@@ -107,13 +233,21 @@ class ComputeController:
         consensus_path: str,
         epoch: int = 0,
         heartbeat_interval: float | None = None,
+        config: dict | None = None,
+        retries: int = 3,
+        deadlines: dict | None = None,
     ):
         self.addrs = list(replica_addrs)
         self.epoch = epoch
-        self.history: list = [p.CreateInstance(blob_path, consensus_path)]
+        self.history: list = [
+            p.CreateInstance(blob_path, consensus_path, dict(config or {}))
+        ]
         self.replicas: list[ReplicaClient | None] = [None] * len(self.addrs)
         self.frontier = 0
+        self.retries = retries
+        self.deadlines = deadlines
         self.last_pong: list[float | None] = [None] * len(self.addrs)
+        self._rng = random.Random()  # backoff jitter only
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         for i in range(len(self.addrs)):
@@ -126,7 +260,9 @@ class ComputeController:
         r = self.replicas[i]
         if r is not None and r.sock is not None:
             return r
-        r = ReplicaClient(self.addrs[i], self.epoch)
+        r = ReplicaClient(
+            self.addrs[i], self.epoch, label=f"replica{i}", deadlines=self.deadlines
+        )
         try:
             r.connect()
         except (ConnectionError, OSError):
@@ -144,24 +280,31 @@ class ComputeController:
 
     def _broadcast(self, cmd, record: bool = True):
         """Send to every reachable replica; a dead replica is dropped (it will
-        be reconciled on reconnect)."""
+        be reconciled on reconnect). Idempotent commands retry the whole
+        fan-out under capped exponential backoff when NO replica answered —
+        each retry reconnects and replays history first, so a replica that
+        blipped mid-command converges to the same state."""
         if record:
             self.history = reduce_command_history(self.history, cmd)
-        out = []
-        for i in range(len(self.addrs)):
-            r = self._ensure_replica(i)
-            if r is None:
-                out.append(None)
-                continue
-            try:
-                out.append(r.request(cmd))
-            except (ConnectionError, OSError):
-                r.close()
-                self.replicas[i] = None
-                out.append(None)
-        if all(o is None for o in out):
-            raise ConnectionError("no live replicas")
-        return out
+        attempts = 1 + (self.retries if isinstance(cmd, IDEMPOTENT_COMMANDS) else 0)
+        for attempt in range(attempts):
+            out = []
+            for i in range(len(self.addrs)):
+                r = self._ensure_replica(i)
+                if r is None:
+                    out.append(None)
+                    continue
+                try:
+                    out.append(r.request(cmd))
+                except (ConnectionError, OSError):
+                    r.close()
+                    self.replicas[i] = None
+                    out.append(None)
+            if any(o is not None for o in out):
+                return out
+            if attempt < attempts - 1:
+                time.sleep(backoff_delay(attempt, rng=self._rng))
+        raise ConnectionError("no live replicas")
 
     # -- public API (controller.rs:785,897 analogues) --------------------------
     def create_dataflow(self, dataflow_id: str, desc, source_shards: dict, as_of: int):
@@ -264,6 +407,15 @@ class ShardedComputeController:
     the epoch (fencing any in-flight batches of the old generation) and
     replaying the reduced command history against ALL shards — the
     reference's whole-replica rehydration on process failure.
+
+    Self-healing liveness: with `heartbeat_interval`, every shard process is
+    pinged on a timer; `miss_threshold` consecutive missed pongs (or a pong
+    whose mesh epoch lags the controller's — a restarted, state-less shard)
+    marks the replica DEGRADED and drives restart (via the `restart_shard`
+    hook, e.g. the orchestrator's restart_replica) + epoch-bumped reform
+    automatically. The degraded→reform transitions are recorded in
+    `self.events` — the replayable recovery trace the chaos tests compare
+    across seeded runs.
     """
 
     def __init__(
@@ -274,17 +426,55 @@ class ShardedComputeController:
         blob_path: str,
         consensus_path: str,
         epoch: int = 1,
+        config: dict | None = None,
+        heartbeat_interval: float | None = None,
+        miss_threshold: int = 3,
+        restart_shard=None,
+        retries: int = 3,
+        deadlines: dict | None = None,
+        exchange_timeout: float | None = None,
     ):
         self.shard_addrs = [tuple(a) for a in shard_addrs]
         self.mesh_addrs = [tuple(a) for a in mesh_addrs]
         self.workers_per_process = workers_per_process
         self.epoch = epoch
-        self.history: list = [p.CreateInstance(blob_path, consensus_path)]
+        self.config = dict(config or {})
+        self.history: list = [
+            p.CreateInstance(blob_path, consensus_path, dict(self.config))
+        ]
         self.shards: list[ReplicaClient | None] = [None] * len(self.shard_addrs)
         self.frontier = 0
+        self.retries = retries
+        self.deadlines = deadlines
+        self.exchange_timeout = (
+            float(exchange_timeout)
+            if exchange_timeout is not None
+            else float(self.config.get("mesh_exchange_timeout_s", 300.0))
+        )
+        self.miss_threshold = miss_threshold
+        self.restart_shard = restart_shard  # fn(process_index) -> None
+        self.degraded = False
+        # recovery trace: ("degraded", epoch, why) / ("restart", i) /
+        # ("reform", epoch) / ("reform-failed", epoch, why) /
+        # ("recovered", epoch) — deterministic modulo `why` wording
+        self.events: list = []
+        self.last_pong: list[float | None] = [None] * len(self.shard_addrs)
+        self._misses = [0] * len(self.shard_addrs)
+        self._rng = random.Random()  # backoff jitter only
+        # serializes command fan-out against reform: a reform must never tear
+        # sockets out from under an in-flight fan-out, and concurrent healers
+        # (heartbeat thread + a failing command's retry path) must collapse
+        self._cmd_lock = threading.RLock()
+        self._hb_stop = threading.Event()
+        self._hb_thread: threading.Thread | None = None
         self._connect_and_form()
         for cmd in self.history:
-            self._broadcast(cmd, record=False)
+            resps = self._request_all([cmd] * self.n_processes)
+            for i, resp in enumerate(resps):
+                if isinstance(resp, p.CommandErr):
+                    raise RuntimeError(f"shard {i}: {resp.message}")
+        if heartbeat_interval is not None:
+            self.start_heartbeats(heartbeat_interval)
 
     @property
     def n_processes(self) -> int:
@@ -295,10 +485,21 @@ class ShardedComputeController:
         return self.n_processes * self.workers_per_process
 
     # -- mesh lifecycle ----------------------------------------------------
+    def _new_client(self, i: int) -> ReplicaClient:
+        return ReplicaClient(
+            self.shard_addrs[i],
+            self.epoch,
+            label=f"shard{i}",
+            deadlines=self.deadlines,
+        )
+
     def _connect_and_form(self) -> None:
         for i in range(self.n_processes):
-            r = ReplicaClient(self.shard_addrs[i], self.epoch)
-            r.connect()
+            r = self._new_client(i)
+            # a shard respawned by the self-heal path can take a while to
+            # boot (jax import on a loaded box) — give the dial the same
+            # budget the orchestrator's readiness probe gets
+            r.connect(timeout=30.0)
             self.shards[i] = r
         # FormMesh must land on every process concurrently: each blocks
         # until its pairwise connections for this epoch are up
@@ -310,6 +511,7 @@ class ShardedComputeController:
                     self.n_processes,
                     self.workers_per_process,
                     tuple(self.mesh_addrs),
+                    self.exchange_timeout,
                 )
                 for i in range(self.n_processes)
             ]
@@ -324,13 +526,76 @@ class ShardedComputeController:
         """Recover after a shard process restart: new epoch, fresh mesh,
         full history replay (every shard rebuilds its partition together —
         batches from the old epoch can never mix in)."""
-        self.epoch += 1
-        for r in self.shards:
-            if r is not None:
-                r.close()
-        self._connect_and_form()
-        for cmd in self.history:
-            self._broadcast(cmd, record=False)
+        with self._cmd_lock:
+            self.epoch += 1
+            self.events.append(("reform", self.epoch))
+            for r in self.shards:
+                if r is not None:
+                    r.close()
+            self._connect_and_form()
+            for cmd in self.history:
+                resps = self._request_all([cmd] * self.n_processes)
+                for i, resp in enumerate(resps):
+                    if isinstance(resp, p.CommandErr):
+                        raise RuntimeError(
+                            f"reform replay: shard {i}: {resp.message}"
+                        )
+            self.degraded = False
+            self._misses = [0] * self.n_processes
+            self.events.append(("recovered", self.epoch))
+
+    def _heal_and_reform(self, failure_epoch: int, reason: str,
+                         max_attempts: int | None = None) -> bool:
+        """Self-healing: restart unreachable shard processes (when a
+        `restart_shard` hook was given), then reform at a bumped epoch.
+        Concurrent healers collapse: whoever holds the lock first does the
+        work, later entrants see the advanced epoch and return."""
+        attempts = max_attempts if max_attempts is not None else 1 + self.retries
+        with self._cmd_lock:
+            if self.epoch > failure_epoch and not self.degraded:
+                return True  # another path already reformed past the failure
+            if not self.degraded:
+                self.degraded = True
+                self.events.append(("degraded", failure_epoch, reason))
+            for attempt in range(attempts):
+                for i in range(self.n_processes):
+                    if not self._reachable(i):
+                        self.events.append(("restart", i))
+                        if self.restart_shard is not None:
+                            try:
+                                self.restart_shard(i)
+                            except Exception:
+                                pass  # probed again next attempt
+                try:
+                    self.reform()
+                    return True
+                except (ConnectionError, OSError, RuntimeError) as e:
+                    self.events.append(("reform-failed", self.epoch, str(e)[:200]))
+                    if attempt < attempts - 1:
+                        time.sleep(backoff_delay(attempt, rng=self._rng))
+            return False
+
+    def _reachable(self, i: int) -> bool:
+        """Full Ping round-trip, not a bare connect: some network stacks
+        accept a dial to a dead port (backlog/sandbox semantics) and only
+        fail on first I/O — a probe must prove the shard actually answers."""
+        try:
+            with socket.create_connection(self.shard_addrs[i], timeout=1.0) as s:
+                s.settimeout(2.0)
+                p.send_frame(s, p.Ping())
+                return p.recv_frame(s) is not None
+        except OSError:
+            return False
+
+    def _await_healthy(self, timeout: float = 30.0) -> None:
+        """Wait out an in-flight reform (the graceful-degradation window)."""
+        deadline = time.time() + timeout
+        while self.degraded and time.time() < deadline:
+            time.sleep(0.05)
+        if self.degraded:
+            raise ReplicaDegraded(
+                f"sharded replica still degraded after {timeout:.0f}s"
+            )
 
     # -- command fan-out ---------------------------------------------------
     def _request_all(self, cmds: list):
@@ -348,6 +613,10 @@ class ShardedComputeController:
                 resps[i] = r.request(cmds[i])
             except (ConnectionError, OSError) as e:
                 errs[i] = e
+                # a failed/timed-out request leaves the stream desynced (its
+                # response may still arrive later) — close so recovery paths
+                # re-dial a clean connection
+                r.close()
 
         threads = [
             threading.Thread(target=run, args=(i,)) for i in range(self.n_processes)
@@ -362,13 +631,40 @@ class ShardedComputeController:
         return resps
 
     def _broadcast(self, cmd, record: bool = True):
+        """Fan out with per-command deadlines; idempotent commands that fail
+        (connection loss, a shard's MeshError) trigger heal+reform and are
+        retried under capped exponential backoff — the reform's history
+        replay already re-delivers the recorded command, so the retry is a
+        frontier no-op on shards that absorbed it."""
         if record:
             self.history = reduce_command_history(self.history, cmd)
-        resps = self._request_all([cmd] * self.n_processes)
-        for i, resp in enumerate(resps):
-            if isinstance(resp, p.CommandErr):
-                raise RuntimeError(f"shard {i}: {resp.message}")
-        return resps
+        attempts = 1 + (self.retries if isinstance(cmd, IDEMPOTENT_COMMANDS) else 0)
+        last: Exception | None = None
+        for attempt in range(attempts):
+            failure_epoch = self.epoch
+            try:
+                with self._cmd_lock:
+                    resps = self._request_all([cmd] * self.n_processes)
+                for i, resp in enumerate(resps):
+                    if isinstance(resp, p.CommandErr):
+                        if resp.message.startswith("MeshError"):
+                            raise ConnectionError(f"shard {i}: {resp.message}")
+                        raise RuntimeError(f"shard {i}: {resp.message}")
+                return resps
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt == attempts - 1:
+                    break
+                time.sleep(backoff_delay(attempt, rng=self._rng))
+                # full internal reform attempts: keeping recovery inside ONE
+                # healer (instead of one reform per outer retry) converges in
+                # fewer epochs and keeps the recovery trace stable
+                self._heal_and_reform(
+                    failure_epoch, f"{type(cmd).__name__} failed: {e}"
+                )
+        raise ConnectionError(
+            f"{type(cmd).__name__} failed after {attempts} attempt(s): {last}"
+        )
 
     # -- public API --------------------------------------------------------
     def create_dataflow(self, dataflow_id: str, desc, source_shards: dict, as_of: int):
@@ -393,28 +689,161 @@ class ShardedComputeController:
                 merged[df_id] = upper if cur is None else min(cur, upper)
         return merged
 
+    def _redial_shard(self, i: int) -> None:
+        """Fresh command connection to shard i (Hello only — clusterd state
+        is process-global, so a re-dial never loses dataflows)."""
+        old = self.shards[i]
+        if old is not None:
+            old.close()
+        r = self._new_client(i)
+        r.connect(timeout=2.0)
+        self.shards[i] = r
+
     def peek(self, dataflow_id: str, index_id: str, at=None):
         """Every shard holds a disjoint partition: fan out, require ALL
-        responses, and merge into the canonical output order."""
-        uid = uuidlib.uuid4().hex
-        resps = self._request_all(
-            [p.Peek(uid, dataflow_id, index_id, at)] * self.n_processes
-        )
-        rows: list = []
-        for i, resp in enumerate(resps):
-            if not isinstance(resp, p.PeekResponse):
-                raise RuntimeError(f"shard {i}: unexpected {resp!r}")
-            if resp.error is not None:
-                raise RuntimeError(f"peek {index_id}: shard {i}: {resp.error}")
-            rows.extend(resp.rows)
-        # merged partitions re-sort with THE canonical peek order so the
-        # result is byte-identical to the 1-process path
-        from ..dataflow.runtime import peek_row_key
+        responses, and merge into the canonical output order. Transient
+        connection failures (a dropped frame, a blipped link) re-dial the
+        failed shards and retry under a FRESH nonce — a late response to a
+        retired nonce is discarded by the request path, never merged."""
+        attempts = 1 + self.retries
+        last: Exception | None = None
+        for attempt in range(attempts):
+            if self.degraded:
+                if self._hb_thread is None:
+                    # no heartbeat thread to re-arm recovery after a failed
+                    # heal: the read path must, or degraded latches forever
+                    # on a read-only workload even after the fault clears
+                    self._heal_and_reform(
+                        self.epoch, "peek: re-arming reform", max_attempts=1
+                    )
+                else:
+                    self._await_healthy()
+            uid = uuidlib.uuid4().hex  # fresh nonce per attempt
+            failure_epoch = self.epoch
+            try:
+                with self._cmd_lock:
+                    resps = self._request_all(
+                        [p.Peek(uid, dataflow_id, index_id, at)] * self.n_processes
+                    )
+                rows: list = []
+                for i, resp in enumerate(resps):
+                    if not isinstance(resp, p.PeekResponse):
+                        raise RuntimeError(f"shard {i}: unexpected {resp!r}")
+                    if resp.error is not None:
+                        if resp.error.startswith("MeshError"):
+                            # a restarted shard with no formed mesh: heal
+                            # (reform) and retry, like _broadcast does
+                            raise ConnectionError(
+                                f"shard {i}: {resp.error}"
+                            )
+                        raise RuntimeError(
+                            f"peek {index_id}: shard {i}: {resp.error}"
+                        )
+                    rows.extend(resp.rows)
+                # merged partitions re-sort with THE canonical peek order so
+                # the result is byte-identical to the 1-process path
+                from ..dataflow.runtime import peek_row_key
 
-        rows.sort(key=peek_row_key)
-        return rows
+                rows.sort(key=peek_row_key)
+                return rows
+            except (ConnectionError, OSError) as e:
+                last = e
+                if attempt == attempts - 1:
+                    break
+                time.sleep(backoff_delay(attempt, rng=self._rng))
+                if "MeshError" in str(e):
+                    # an amnesiac shard answers fine but has no mesh/state:
+                    # only an epoch-bumped reform (not a re-dial) repairs it
+                    self._heal_and_reform(failure_epoch, f"Peek failed: {e}")
+                    continue
+                for i, r in enumerate(self.shards):
+                    if r is None or r.sock is None:
+                        try:
+                            self._redial_shard(i)
+                        except (ConnectionError, OSError):
+                            pass
+        raise ConnectionError(
+            f"peek {index_id} failed after {attempts} attempt(s): {last}"
+        )
+
+    # -- liveness ----------------------------------------------------------
+    def start_heartbeats(self, interval: float = 2.0) -> None:
+        """Proactive per-shard liveness (the CTP connection heartbeats,
+        src/service/src/transport.rs:13): ping every shard process on a
+        timer; crossing `miss_threshold` triggers the degraded→reform state
+        machine without waiting for the next command to fail."""
+        if self._hb_thread is not None:
+            return
+        self._hb_stop.clear()
+
+        def loop():
+            while not self._hb_stop.wait(interval):
+                try:
+                    self.heartbeat_once()
+                except Exception:
+                    pass  # the next beat re-probes; commands surface errors
+
+        self._hb_thread = threading.Thread(target=loop, daemon=True)
+        self._hb_thread.start()
+
+    def stop_heartbeats(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=5.0)
+            self._hb_thread = None
+
+    def heartbeat_once(self) -> list[bool]:
+        """Ping each shard once; a dead or amnesiac (mesh epoch < controller
+        epoch) shard counts a miss, and `miss_threshold` misses on any shard
+        trigger self-healing. A shard whose socket is mid-command is skipped
+        (in-flight traffic is its own liveness signal)."""
+        if self.degraded:
+            # a previous heal gave up (shard still down / still partitioned):
+            # keep re-arming one reform attempt per beat until it sticks —
+            # a permanently-degraded replica would be a liveness bug
+            self._heal_and_reform(
+                self.epoch, "still degraded: re-arming reform", max_attempts=1
+            )
+            return [self.degraded is False] * self.n_processes
+        alive: list[bool] = []
+        for i, r in enumerate(self.shards):
+            if r is None or r.sock is None:
+                ok = False
+                try:
+                    self._redial_shard(i)
+                except (ConnectionError, OSError):
+                    pass
+            else:
+                pong = r.try_ping(self.deadlines.get(p.Ping, 5.0)
+                                  if self.deadlines else 5.0)
+                if pong == "busy":
+                    alive.append(True)
+                    continue
+                ok = isinstance(pong, p.Pong) and pong.mesh_epoch == self.epoch
+                if not ok:
+                    r.close()
+                    # a live process with a stale/absent mesh re-dials fine
+                    # but stays unhealthy until the reform re-forms its mesh
+                    try:
+                        self._redial_shard(i)
+                    except (ConnectionError, OSError):
+                        pass
+            if ok:
+                self._misses[i] = 0
+                self.last_pong[i] = time.time()
+            else:
+                self._misses[i] += 1
+            alive.append(ok)
+        dead = [i for i, m in enumerate(self._misses) if m >= self.miss_threshold]
+        if dead and not self.degraded:
+            self._heal_and_reform(
+                self.epoch,
+                f"shards {dead} missed {self.miss_threshold} heartbeats",
+            )
+        return alive
 
     def close(self) -> None:
+        self.stop_heartbeats()
         for r in self.shards:
             if r is not None:
                 r.close()

@@ -1,0 +1,230 @@
+"""Deterministic fault injection for the CTP transport.
+
+The analogue of the reference's turmoil-style deterministic network
+simulation (persist is validated under a seeded network simulator; the
+ROADMAP's "turmoil-style deterministic network simulation for partition
+tests" gap): a `FaultPlan` is a seeded schedule of frame drops, delays,
+duplicates, and mid-frame connection resets, plus pairwise partitions /
+blackholes, threaded UNDER `protocol.send_frame`/`recv_frame` via an
+injectable transport hook. Only frames sent on *labeled* links (the
+controller↔shard command channel and the worker-mesh data plane label their
+sockets; handshakes and unlabeled test sockets are never faulted) consult
+the plan.
+
+Determinism contract: each link direction keeps its own frame counter, and
+every decision is a pure function of `(seed, direction, src, dst, n)` — so
+the same seed replays the exact same per-link failure sequence regardless of
+cross-link thread interleaving. The applied decisions are recorded in
+`plan.trace`; tests assert "same seed ⇒ same trace ⇒ same recovery outcome"
+and chaos CI failures print the seed for replay (`FAULT_SEED=<n>`).
+
+Cross-process: `plan.to_spec()` serializes the schedule; clusterd installs it
+at startup from the `MZT_FAULT_SPEC` environment variable
+(`install_from_env`), so subprocess shard meshes run under the same seeded
+simulation as the in-process controller.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+ENV_SPEC = "MZT_FAULT_SPEC"
+
+# frame kinds eligible for duplication: idempotent on the receiver (mesh
+# data frames are slot-keyed in the inbox; duplicated PeekResponses are
+# discarded by nonce). Duplicating e.g. a command frame would make the
+# request/response stream lie about itself rather than the network.
+def _dup_eligible(obj) -> bool:
+    if isinstance(obj, tuple) and obj and obj[0] == "data":
+        return True
+    return type(obj).__name__ == "PeekResponse"
+
+
+@dataclass(frozen=True)
+class FaultAction:
+    kind: str  # deliver | drop | delay | dup | reset | blackhole
+    delay: float = 0.0
+
+
+_DELIVER = FaultAction("deliver")
+
+
+class FaultPlan:
+    """A seeded, replayable schedule of transport faults.
+
+    Probabilities are per-frame, drawn independently per link direction:
+    `reset_prob` (mid-frame connection reset), `drop_prob` (frame vanishes),
+    `dup_prob` (frame delivered twice; downgraded to deliver for frames
+    whose duplication the receiver cannot dedup), `delay_prob`/`delay_s`
+    (frame delayed before delivery). `partitions` are scheduled DIRECTED
+    blackholes: (a, b, lo, hi) drops every frame flowing a→b whose per-link
+    index n satisfies lo <= n < hi (hi=None: forever) — directed so a test
+    can target exactly one frame of one flow. `partition(a, b)` / `heal(a,
+    b)` flip a SYMMETRIC blackhole at runtime (a real partition cuts both
+    directions) — the zippy chaos actions.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        drop_prob: float = 0.0,
+        delay_prob: float = 0.0,
+        delay_s: float = 0.02,
+        dup_prob: float = 0.0,
+        reset_prob: float = 0.0,
+        partitions: tuple = (),
+    ):
+        self.seed = int(seed)
+        self.drop_prob = float(drop_prob)
+        self.delay_prob = float(delay_prob)
+        self.delay_s = float(delay_s)
+        self.dup_prob = float(dup_prob)
+        self.reset_prob = float(reset_prob)
+        # scheduled windows, directed: ((a, b), lo, hi|None)
+        self._windows = [
+            ((a, b), int(lo), None if hi is None else int(hi))
+            for (a, b, lo, hi) in partitions
+        ]
+        self._dynamic: set = set()  # frozenset({a,b}) live blackholes
+        self._bursts: dict = {}  # frozenset({a,b}) -> [frames left, delay_s]
+        self._counters: dict = {}  # (direction, src, dst) -> frames seen
+        self._lock = threading.Lock()
+        self.trace: list = []  # (direction, src, dst, n, kind) for anomalies
+
+    # -- chaos actions -----------------------------------------------------
+    def partition(self, a: str, b: str) -> None:
+        with self._lock:
+            self._dynamic.add(frozenset((a, b)))
+
+    def heal(self, a: str | None = None, b: str | None = None) -> None:
+        with self._lock:
+            if a is None:
+                self._dynamic.clear()
+            else:
+                self._dynamic.discard(frozenset((a, b)))
+
+    def delay_burst(self, a: str, b: str, frames: int,
+                    delay_s: float | None = None) -> None:
+        """Chaos action: delay the next `frames` frames between a and b —
+        a latency spike that exercises deadlines without losing anything."""
+        with self._lock:
+            self._bursts[frozenset((a, b))] = [
+                int(frames), self.delay_s if delay_s is None else float(delay_s)
+            ]
+
+    # -- the decision function ---------------------------------------------
+    def _decide(self, direction: str, link: tuple, obj) -> FaultAction:
+        src, dst = link
+        pair = frozenset((src, dst))
+        with self._lock:
+            key = (direction, src, dst)
+            n = self._counters.get(key, 0)
+            self._counters[key] = n + 1
+            for wlink, lo, hi in self._windows:
+                if wlink == link and n >= lo and (hi is None or n < hi):
+                    self.trace.append((direction, src, dst, n, "blackhole"))
+                    return FaultAction("blackhole")
+            if pair in self._dynamic:
+                self.trace.append((direction, src, dst, n, "blackhole"))
+                return FaultAction("blackhole")
+            burst = self._bursts.get(pair)
+            if burst is not None and direction == "send":
+                burst[0] -= 1
+                if burst[0] <= 0:
+                    del self._bursts[pair]
+                self.trace.append((direction, src, dst, n, "delay"))
+                return FaultAction("delay", burst[1])
+        r = random.Random(f"{self.seed}|{direction}|{src}>{dst}|{n}").random()
+        kind = "deliver"
+        edge = self.reset_prob
+        if r < edge:
+            kind = "reset"
+        elif r < (edge := edge + self.drop_prob):
+            kind = "drop"
+        elif r < (edge := edge + self.dup_prob):
+            kind = "dup" if _dup_eligible(obj) else "deliver"
+        elif r < edge + self.delay_prob:
+            kind = "delay"
+        if kind == "deliver":
+            return _DELIVER
+        with self._lock:
+            self.trace.append((direction, src, dst, n, kind))
+        return FaultAction(kind, self.delay_s if kind == "delay" else 0.0)
+
+    # transport-hook surface consulted by protocol.send_frame/recv_frame
+    def on_send(self, link: tuple, obj) -> FaultAction:
+        return self._decide("send", link, obj)
+
+    def on_recv(self, link: tuple, obj) -> FaultAction:
+        act = self._decide("recv", link, obj)
+        # dup/reset are send-side notions; receive-side faults are loss only
+        if act.kind in ("dup", "reset"):
+            return _DELIVER
+        return act
+
+    # -- serialization (controller process -> clusterd subprocesses) -------
+    def to_spec(self) -> str:
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "drop_prob": self.drop_prob,
+                "delay_prob": self.delay_prob,
+                "delay_s": self.delay_s,
+                "dup_prob": self.dup_prob,
+                "reset_prob": self.reset_prob,
+                "partitions": [
+                    [a, b, lo, hi] for (a, b), lo, hi in self._windows
+                ],
+            }
+        )
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        d = json.loads(spec)
+        return cls(
+            d["seed"],
+            drop_prob=d.get("drop_prob", 0.0),
+            delay_prob=d.get("delay_prob", 0.0),
+            delay_s=d.get("delay_s", 0.02),
+            dup_prob=d.get("dup_prob", 0.0),
+            reset_prob=d.get("reset_prob", 0.0),
+            partitions=tuple(tuple(p) for p in d.get("partitions", ())),
+        )
+
+
+def install(plan: FaultPlan | None) -> None:
+    """Install `plan` as THE process-wide transport hook (None uninstalls)."""
+    from . import protocol
+
+    protocol.set_transport_hook(plan)
+
+
+def installed_plan():
+    from . import protocol
+
+    return protocol.transport_hook()
+
+
+def install_from_env() -> FaultPlan | None:
+    """clusterd startup: adopt the spawning test's fault schedule, if any."""
+    spec = os.environ.get(ENV_SPEC)
+    if not spec:
+        return None
+    plan = FaultPlan.from_spec(spec)
+    install(plan)
+    return plan
+
+
+@contextmanager
+def injected(plan: FaultPlan):
+    """Test scoping: install `plan` for the body, always uninstall after."""
+    install(plan)
+    try:
+        yield plan
+    finally:
+        install(None)

@@ -43,6 +43,9 @@ from . import protocol as p
 #   ("hello", epoch, from_process)        handshake, dialer -> acceptor
 #   ("ok", epoch) | ("fenced", epoch)     handshake reply
 #   ("data", epoch, channel, tick, src_worker, dst_worker, payload)
+#   ("poison", epoch, channel, tick, reason)   partial-send abort: collectors
+#       of (channel, tick) at this epoch fail fast instead of stalling on a
+#       half-delivered exchange (the reform then discards the slot entirely)
 
 
 class MeshError(RuntimeError):
@@ -60,6 +63,9 @@ class _Inbox:
         self._cv = threading.Condition()
         self._slots: dict = {}
         self._failed: Optional[str] = None
+        # (epoch, channel, tick) -> reason: a peer aborted this exchange
+        # after a partial send; every collector must discard it
+        self._poisoned: dict = {}
         # (epoch, dst, channel) -> last closed tick (progress frontier)
         self._frontiers: dict = {}
 
@@ -75,18 +81,35 @@ class _Inbox:
             self._failed = reason
             self._cv.notify_all()
 
+    def poison(self, epoch: int, channel, tick: int, reason: str) -> None:
+        """Mark one (channel, tick) exchange of `epoch` as dead: a sender
+        failed after delivering to SOME peers, so the tick can never complete
+        consistently. Collectors fail fast; the epoch-bumping reform then
+        clears the slot, so the half-delivered tick can never be folded in."""
+        with self._cv:
+            self._poisoned[(epoch, channel, tick)] = reason
+            self._cv.notify_all()
+
     def collect(
         self, epoch: int, dst: int, channel, tick: int, n: int, timeout: float
     ):
         """Block until all `n` parts for (channel, tick) addressed to `dst`
         arrived; returns them ordered by source worker and closes the tick."""
         key = (epoch, dst, channel, tick)
+        pkey = (epoch, channel, tick)
         with self._cv:
             ok = self._cv.wait_for(
                 lambda: self._failed is not None
+                or pkey in self._poisoned
                 or len(self._slots.get(key, {})) >= n,
                 timeout=timeout,
             )
+            if pkey in self._poisoned:
+                self._slots.pop(key, None)
+                raise MeshError(
+                    f"exchange poisoned: channel {channel} tick {tick}: "
+                    f"{self._poisoned[pkey]}"
+                )
             slot = self._slots.get(key, {})
             if len(slot) < n:
                 if self._failed is not None:
@@ -111,6 +134,7 @@ class _Inbox:
         with self._cv:
             self._slots.clear()
             self._frontiers.clear()
+            self._poisoned.clear()
             self._failed = None
             self._cv.notify_all()
 
@@ -130,6 +154,9 @@ class WorkerMesh:
         self.process_index = 0
         self.n_processes = 1
         self.workers_per_process = 1
+        # per-tick exchange deadline (FormMesh.exchange_timeout): bounds how
+        # long a collect may stall before MeshError -> controller reform
+        self.exchange_timeout = 300.0
         self._conns: dict[int, socket.socket] = {}  # peer process -> sock
         self._send_locks: dict[int, threading.Lock] = {}
         self.inbox = _Inbox()
@@ -186,6 +213,7 @@ class WorkerMesh:
         workers_per_process: int,
         peer_addrs: list,
         timeout: float = 30.0,
+        exchange_timeout: float | None = None,
     ) -> None:
         """(Re)form the full mesh at `epoch`. Dials every lower-indexed peer
         and waits for every higher-indexed peer's dial; the previous epoch's
@@ -207,6 +235,8 @@ class WorkerMesh:
             self.process_index = process_index
             self.n_processes = n_processes
             self.workers_per_process = workers_per_process
+            if exchange_timeout is not None:
+                self.exchange_timeout = float(exchange_timeout)
             # drop stale pending handshakes
             for e in [e for e in self._pending if e < epoch]:
                 for sock in self._pending[e].values():
@@ -270,13 +300,23 @@ class WorkerMesh:
             target=self._recv_loop, args=(peer, sock, self.epoch), daemon=True
         ).start()
 
+    def _link(self, peer: int) -> tuple:
+        """Fault-injection link label for frames we SEND to `peer`; the
+        receive direction is the reverse tuple."""
+        return (f"proc{self.process_index}", f"proc{peer}")
+
     # -- data plane --------------------------------------------------------
     def _recv_loop(self, peer: int, sock: socket.socket, epoch: int) -> None:
+        link = (f"proc{peer}", f"proc{self.process_index}")
         try:
             while True:
-                frame = p.recv_frame(sock)
+                frame = p.recv_frame(sock, link=link)
                 if frame is None:
                     break
+                if isinstance(frame, tuple) and frame[0] == "poison":
+                    _tag, f_epoch, channel, tick, reason = frame
+                    self.inbox.poison(f_epoch, channel, tick, reason)
+                    continue
                 if not (isinstance(frame, tuple) and frame[0] == "data"):
                     continue
                 _tag, f_epoch, channel, tick, src, dst, payload = frame
@@ -297,7 +337,7 @@ class WorkerMesh:
         channel,
         tick: int,
         parts: list,
-        timeout: float = 300.0,
+        timeout: float | None = None,
     ) -> list:
         """One worker's participation in one exchange: send `parts[d]` to
         every worker d (None = empty punctuation), then block until all
@@ -305,6 +345,8 @@ class WorkerMesh:
         Returns the received parts ordered by source worker."""
         n = self.n_workers
         assert len(parts) == n, f"need {n} parts, got {len(parts)}"
+        if timeout is None:
+            timeout = self.exchange_timeout
         epoch = self.epoch
         for dst in range(n):
             proc = self.process_of(dst)
@@ -316,14 +358,40 @@ class WorkerMesh:
                 sock = self._conns.get(proc)
                 slock = self._send_locks.get(proc)
             if sock is None:
+                self._poison_exchange(
+                    epoch, channel, tick, f"no connection to shard process {proc}"
+                )
                 raise MeshError(f"no connection to shard process {proc}")
             try:
                 with slock:
-                    p.send_frame(sock, frame)
+                    p.send_frame(sock, frame, link=self._link(proc))
             except (OSError, ConnectionError) as e:
-                self.inbox.fail(f"send to shard process {proc} failed: {e}")
+                # partial send: peers before `proc` already hold our part for
+                # this tick and would stall waiting for the rest — poison the
+                # (channel, tick) everywhere so every collector aborts fast
+                # and the epoch-bumping reform discards the half-delivered tick
+                self._poison_exchange(
+                    epoch, channel, tick,
+                    f"partial send: shard process {proc} unreachable: {e}",
+                )
                 raise MeshError(str(e))
         return self.inbox.collect(epoch, worker, channel, tick, n, timeout)
+
+    def _poison_exchange(
+        self, epoch: int, channel, tick: int, reason: str
+    ) -> None:
+        """Poison (channel, tick) locally AND on every still-reachable peer."""
+        self.inbox.poison(epoch, channel, tick, reason)
+        frame = ("poison", epoch, channel, tick, reason)
+        with self._lock:
+            conns = list(self._conns.items())
+            slocks = dict(self._send_locks)
+        for peer, sock in conns:
+            try:
+                with slocks[peer]:
+                    p.send_frame(sock, frame, link=self._link(peer))
+            except (OSError, ConnectionError):
+                pass  # that peer's recv loop will fail the inbox on its own
 
     def close(self) -> None:
         with self._lock:

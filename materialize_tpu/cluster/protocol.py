@@ -19,26 +19,92 @@ from __future__ import annotations
 import pickle
 import socket
 import struct
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 _LEN = struct.Struct(">Q")
 
+# Upper bound on a single frame's payload: the length header is attacker/
+# corruption-controlled, and a desynced stream (mid-frame reset, pickle
+# garbage) would otherwise loop allocating gigabytes in _recv_exact.
+# Dyncfg-able via `ctp_max_frame_bytes` (shipped in CreateInstance.config).
+MAX_FRAME_BYTES = 1 << 30
 
-def send_frame(sock: socket.socket, obj) -> None:
+# The injectable transport hook (cluster/faults.py FaultPlan): consulted by
+# send_frame/recv_frame for frames on LABELED links only, so the seeded
+# deterministic fault schedule runs under the real framing code with zero
+# overhead when no plan is installed.
+_transport_hook = None
+
+
+def set_transport_hook(hook) -> None:
+    global _transport_hook
+    _transport_hook = hook
+
+
+def transport_hook():
+    return _transport_hook
+
+
+def set_max_frame_bytes(n: int) -> None:
+    global MAX_FRAME_BYTES
+    MAX_FRAME_BYTES = int(n)
+
+
+def send_frame(sock: socket.socket, obj, link: tuple | None = None) -> None:
     payload = pickle.dumps(obj)
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    frame = _LEN.pack(len(payload)) + payload
+    hook = _transport_hook
+    if hook is not None and link is not None:
+        act = hook.on_send(link, obj)
+        if act.kind in ("drop", "blackhole"):
+            return
+        if act.kind == "delay":
+            time.sleep(act.delay)
+        elif act.kind == "reset":
+            # mid-frame cut: ship half the frame, then hard-close — the peer
+            # sees a short read, the next local send sees a dead socket
+            try:
+                sock.sendall(frame[: max(1, len(frame) // 2)])
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+            raise ConnectionResetError(f"fault injection: reset on link {link}")
+        sock.sendall(frame)
+        if act.kind == "dup":
+            sock.sendall(frame)
+        return
+    sock.sendall(frame)
 
 
-def recv_frame(sock: socket.socket):
-    header = _recv_exact(sock, _LEN.size)
-    if header is None:
-        return None
-    (n,) = _LEN.unpack(header)
-    payload = _recv_exact(sock, n)
-    if payload is None:
-        return None
-    return pickle.loads(payload)
+def recv_frame(sock: socket.socket, link: tuple | None = None):
+    while True:
+        header = _recv_exact(sock, _LEN.size)
+        if header is None:
+            return None
+        (n,) = _LEN.unpack(header)
+        if n > MAX_FRAME_BYTES:
+            raise ConnectionError(
+                f"CTP frame length {n} exceeds the {MAX_FRAME_BYTES}-byte cap "
+                "(corrupt or desynced stream)"
+            )
+        payload = _recv_exact(sock, n)
+        if payload is None:
+            return None
+        obj = pickle.loads(payload)
+        hook = _transport_hook
+        if hook is not None and link is not None:
+            act = hook.on_recv(link, obj)
+            if act.kind in ("drop", "blackhole"):
+                continue  # inbound loss: the frame never happened
+            if act.kind == "delay":
+                time.sleep(act.delay)
+        return obj
 
 
 def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
@@ -121,6 +187,10 @@ class FormMesh:
     n_processes: int
     workers_per_process: int
     peer_mesh_addrs: tuple  # ((host, port), ...) indexed by process
+    # per-tick exchange deadline: a stalled inbox.collect becomes a MeshError
+    # (-> controller-driven reform) after this many seconds, instead of a
+    # 300 s hang holding the clusterd command lock
+    exchange_timeout: float = 300.0
 
 
 # -- responses --------------------------------------------------------------
@@ -146,6 +216,11 @@ class CommandErr:
 @dataclass(frozen=True)
 class Pong:
     epoch: int
+    # sharded clusterd only: the epoch of its FORMED mesh (-1 = no formed
+    # mesh). A restarted shard answers Hello/Ping happily but has lost its
+    # mesh and state — mesh_epoch != controller epoch is how heartbeats tell
+    # a live-but-amnesiac shard from a healthy one.
+    mesh_epoch: int = -1
 
 
 @dataclass(frozen=True)

@@ -56,11 +56,21 @@ class ShardContext:
         self.worker = worker
         self.n_workers = n_workers
         self._next_channel = 0
+        # per-TICK exchange deadline (set by Dataflow.step via begin_tick):
+        # all of a tick's exchanges share one budget, so a tick with many
+        # channels can't stretch a stall to channels × per-exchange timeout
+        self._tick_deadline: Optional[float] = None
 
     def alloc_channel(self):
         c = self._next_channel
         self._next_channel += 1
         return (self.dataflow_id, c)
+
+    def begin_tick(self, tick: int) -> None:
+        import time as _time
+
+        budget = getattr(self.mesh, "exchange_timeout", 300.0)
+        self._tick_deadline = _time.perf_counter() + budget
 
     def exchange(
         self, channel, tick: int, batch: Optional[UpdateBatch], key_cols
@@ -68,11 +78,19 @@ class ShardContext:
         """Route `batch`'s live rows by hash of `key_cols` (None = whole row,
         () = keyless → worker 0); blocks until every peer's part for this
         (channel, tick) arrived — the per-channel progress accounting that
-        makes closing a timestamp safe."""
+        makes closing a timestamp safe. A stall past the tick's shared
+        deadline raises MeshError (the controller then reforms the mesh)."""
+        import time as _time
+
         from ..parallel.netexchange import merge_parts, partition_batch
 
         parts = partition_batch(batch, key_cols, self.n_workers)
-        received = self.mesh.exchange(self.worker, channel, tick, parts)
+        timeout = None
+        if self._tick_deadline is not None:
+            timeout = max(0.05, self._tick_deadline - _time.perf_counter())
+        received = self.mesh.exchange(
+            self.worker, channel, tick, parts, timeout=timeout
+        )
         return merge_parts(received)
 
 
@@ -1330,6 +1348,8 @@ class Dataflow:
         """
         import time as _time
 
+        if self.shard is not None:
+            self.shard.begin_tick(tick)
         env: dict[str, Delta] = {}
         for sid, batch in source_deltas.items():
             env[sid] = (batch, None)

@@ -45,9 +45,13 @@ class Service:
 
 
 class ProcessOrchestrator:
-    def __init__(self, cpu: bool = True):
+    def __init__(self, cpu: bool = True, extra_env: dict | None = None):
+        # `extra_env`: additional environment for spawned replicas — the
+        # chaos tests ship the seeded fault schedule (MZT_FAULT_SPEC,
+        # cluster/faults.py) to clusterd subprocesses this way
         self.services: dict[str, Service] = {}
         self.cpu = cpu
+        self.extra_env = dict(extra_env or {})
 
     def _spawn(self, port: int, mesh_port: int | None):
         args = [
@@ -61,7 +65,9 @@ class ProcessOrchestrator:
             args += ["--mesh-port", str(mesh_port)]
         if self.cpu:
             args.append("--cpu")
-        return subprocess.Popen(args, env=_replica_env(self.cpu))
+        env = _replica_env(self.cpu)
+        env.update(self.extra_env)
+        return subprocess.Popen(args, env=env)
 
     def ensure_service(self, name: str, scale: int = 1) -> list[tuple]:
         """Start (or resize to) `scale` clusterd replicas; returns addresses."""
@@ -129,6 +135,21 @@ class ProcessOrchestrator:
                     if time.time() > deadline:
                         raise TimeoutError(f"replica on :{port} never came up")
                     time.sleep(0.1)
+
+    def replica_alive(self, name: str, idx: int) -> bool:
+        """Health probe: is the replica process still running?"""
+        return self.services[name].processes[idx].poll() is None
+
+    def restarter(self, name: str):
+        """A restart hook for ShardedComputeController(restart_shard=...):
+        respawns shard `idx` at its original ports if its process died —
+        the self-healing half the controller itself cannot do."""
+
+        def restart(idx: int) -> None:
+            if not self.replica_alive(name, idx):
+                self.restart_replica(name, idx)
+
+        return restart
 
     def kill_replica(self, name: str, idx: int) -> None:
         """Fault injection: kill one replica process (it stays in the service

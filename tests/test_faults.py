@@ -1,0 +1,477 @@
+"""Deterministic fault injection + self-healing (cluster/faults.py).
+
+The seeded fault-schedule regression tier: every test here is in-process and
+fast (smoke marker), driving the REAL transport/controller/mesh code under a
+`FaultPlan` or a scripted failure, and asserting that
+
+* the same seed replays the exact same per-link failure sequence,
+* a corrupt frame-length header fails cleanly instead of allocating wild,
+* `ReplicaClient.connect` never leaks sockets across handshake failures,
+* a duplicated PeekResponse is discarded by nonce (never double-delivered),
+* a controller↔shard partition during a Peek is survived by a deadline +
+  fresh-nonce retry,
+* a partial mesh send poisons the half-delivered tick on every peer,
+* the degraded→restart→reform state machine heals a killed shard.
+"""
+
+import socket
+import threading
+import time
+
+import pytest
+
+from materialize_tpu.cluster import (
+    FaultPlan,
+    MeshError,
+    ReplicaClient,
+    ShardedComputeController,
+    WorkerMesh,
+    faults,
+)
+from materialize_tpu.cluster import protocol as p
+
+
+# -- a scripted in-process shard (CTP server) --------------------------------
+
+
+class FakeShard:
+    """A minimal clusterd stand-in: real CTP framing, scripted state. Lets
+    controller-hardening tests run the true client code paths (deadlines,
+    redials, nonce retry, heartbeat state machine) without subprocesses."""
+
+    def __init__(self, port: int = 0, dup_peek: bool = False):
+        self.epoch = -1
+        self.mesh_epoch = -1  # -1 until FormMesh: a fresh/amnesiac shard
+        self.dup_peek = dup_peek
+        self.peek_uuids: list = []
+        self.hellos = 0
+        self.rows = [(1, 10)]
+        self._srv = socket.create_server(("127.0.0.1", port))
+        self.addr = self._srv.getsockname()
+        self._alive = True
+        self._conns: list = []
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _accept(self):
+        srv = self._srv
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            # a kill() may not interrupt a blocked accept on every platform:
+            # refuse (close) anything accepted while dead
+            if not self._alive or srv is not self._srv:
+                conn.close()
+                continue
+            self._conns.append(conn)
+            threading.Thread(target=self._client, args=(conn,), daemon=True).start()
+
+    def _client(self, conn):
+        try:
+            while True:
+                cmd = p.recv_frame(conn)
+                if cmd is None or not self._alive:
+                    return
+                for resp in self._handle(cmd):
+                    p.send_frame(conn, resp)
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, cmd):
+        if isinstance(cmd, p.Hello):
+            self.hellos += 1
+            self.epoch = max(self.epoch, cmd.epoch)
+            return [p.Pong(self.epoch, self.mesh_epoch)]
+        if isinstance(cmd, p.Ping):
+            return [p.Pong(self.epoch, self.mesh_epoch)]
+        if isinstance(cmd, p.FormMesh):
+            self.epoch = cmd.epoch
+            self.mesh_epoch = cmd.epoch
+            return [p.MeshReady(cmd.epoch, cmd.n_processes * cmd.workers_per_process)]
+        if isinstance(cmd, (p.CreateInstance, p.CreateDataflow, p.ProcessTo,
+                            p.AllowCompaction)):
+            return [p.Frontiers({})]
+        if isinstance(cmd, p.Peek):
+            self.peek_uuids.append(cmd.uuid)
+            resp = p.PeekResponse(cmd.uuid, list(self.rows))
+            return [resp, resp] if self.dup_peek else [resp]
+        return [p.CommandErr(f"unhandled {type(cmd).__name__}")]
+
+    def kill(self):
+        self._alive = False
+        try:
+            self._srv.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        for conn in self._conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns = []
+
+    def revive(self):
+        """Restart on the SAME port, state-less (mesh_epoch back to -1)."""
+        self.mesh_epoch = -1
+        self._srv = socket.create_server(("127.0.0.1", self.addr[1]))
+        self._alive = True
+        threading.Thread(target=self._accept, daemon=True).start()
+
+
+# -- seeded determinism ------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_fault_plan_same_seed_same_trace_smoke():
+    """The determinism contract: decisions are pure in (seed, link, n), so
+    two plans with one seed produce identical per-link traces regardless of
+    cross-link interleaving — the replay property every chaos test leans on."""
+    def drive(plan):
+        # interleave two links differently on each run: per-link sequences
+        # must not care
+        for i in range(40):
+            plan.on_send(("ctl", "shard0"), p.Ping())
+            if i % 2:
+                plan.on_send(("proc0", "proc1"), ("data",))
+        for _ in range(20):
+            plan.on_send(("proc0", "proc1"), ("data",))
+        return sorted(plan.trace)
+
+    a = drive(FaultPlan(42, drop_prob=0.2, delay_prob=0.1, dup_prob=0.1))
+    b = drive(FaultPlan(42, drop_prob=0.2, delay_prob=0.1, dup_prob=0.1))
+    c = drive(FaultPlan(43, drop_prob=0.2, delay_prob=0.1, dup_prob=0.1))
+    assert a == b
+    assert a != c
+    assert len(a) > 0
+    # spec roundtrip: the schedule a clusterd subprocess reconstructs from
+    # MZT_FAULT_SPEC is the same schedule
+    plan = FaultPlan(42, drop_prob=0.2, partitions=(("a", "b", 0, 5),))
+    assert FaultPlan.from_spec(plan.to_spec()).to_spec() == plan.to_spec()
+
+
+@pytest.mark.smoke
+def test_scheduled_partition_blackholes_frames_smoke():
+    plan = FaultPlan(0, partitions=(("ctl", "shard0", 1, 3),))
+    kinds = [plan.on_send(("ctl", "shard0"), p.Ping()).kind for _ in range(4)]
+    assert kinds == ["deliver", "blackhole", "blackhole", "deliver"]
+    # dynamic partition + heal (the zippy chaos actions)
+    plan.partition("ctl", "shard0")
+    assert plan.on_send(("ctl", "shard0"), p.Ping()).kind == "blackhole"
+    plan.heal("ctl", "shard0")
+    assert plan.on_send(("ctl", "shard0"), p.Ping()).kind == "deliver"
+
+
+# -- frame-size cap ----------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_recv_frame_rejects_oversized_length_header_smoke():
+    """A corrupt/desynced length header must raise cleanly, not loop
+    allocating gigabytes waiting for a payload that never comes."""
+    a, b = socket.socketpair()
+    try:
+        a.sendall(p._LEN.pack(p.MAX_FRAME_BYTES + 1))
+        b.settimeout(5.0)
+        with pytest.raises(ConnectionError, match="exceeds the .*cap"):
+            p.recv_frame(b)
+    finally:
+        a.close()
+        b.close()
+
+
+# -- connect fd hygiene ------------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_connect_closes_socket_on_handshake_failure_smoke(monkeypatch):
+    """A Hello answered with CommandErr used to leak the dialed socket on
+    every retry; now each failed handshake closes its fd."""
+
+    class Refuser(FakeShard):
+        def _handle(self, cmd):
+            if isinstance(cmd, p.Hello):
+                return [p.CommandErr("fenced: nope")]
+            return super()._handle(cmd)
+
+    shard = Refuser()
+    created: list = []
+    real_create = socket.create_connection
+
+    def tracking_create(*args, **kwargs):
+        s = real_create(*args, **kwargs)
+        created.append(s)
+        return s
+
+    monkeypatch.setattr(socket, "create_connection", tracking_create)
+    client = ReplicaClient(shard.addr, epoch=1)
+    with pytest.raises(ConnectionError, match="fenced"):
+        client.connect(timeout=0.5)
+    assert client.sock is None
+    assert len(created) >= 2  # it retried...
+    assert all(s.fileno() == -1 for s in created)  # ...and leaked nothing
+    shard.kill()
+
+
+# -- duplicate PeekResponse / nonce ------------------------------------------
+
+
+@pytest.mark.smoke
+def test_duplicated_peek_response_discarded_by_nonce_smoke():
+    """A duplicated PeekResponse (the dup fault) must not desync the command
+    stream: the extra copy is discarded by nonce, and the next command still
+    gets ITS response — never a stale peek double-delivered."""
+    shard = FakeShard(dup_peek=True)
+    client = ReplicaClient(shard.addr, epoch=1)
+    client.connect()
+    resp = client.request(p.Peek("n1", "df", "idx"))
+    assert isinstance(resp, p.PeekResponse) and resp.uuid == "n1"
+    # the duplicate is still queued on the wire; the next request must skip it
+    pong = client.request(p.Ping())
+    assert isinstance(pong, p.Pong)
+    # and a peek under a FRESH nonce never sees the retired one
+    resp2 = client.request(p.Peek("n2", "df", "idx"))
+    assert resp2.uuid == "n2"
+    client.close()
+    shard.kill()
+
+
+# -- partition during peek ---------------------------------------------------
+
+
+@pytest.mark.smoke
+def test_partition_during_peek_retried_under_fresh_nonce_smoke():
+    """Seeded regression (b): a ctl↔shard partition eats the first Peek; the
+    per-command deadline converts the stall into a retry that re-dials and
+    re-peeks under a fresh nonce."""
+    shard = FakeShard()
+    # ctl->shard0 send frames: 0=Hello 1=FormMesh 2=CreateInstance 3=Peek;
+    # blackhole exactly the first Peek, then heal
+    with faults.injected(FaultPlan(7, partitions=(("ctl", "shard0", 3, 4),))) as plan:
+        ctl = ShardedComputeController(
+            [shard.addr],
+            [("127.0.0.1", 0)],
+            1,
+            "/tmp/unused-blob",
+            "/tmp/unused-cas",
+            epoch=1,
+            deadlines={p.Peek: 0.5, p.Hello: 2.0},
+        )
+        rows = ctl.peek("df", "idx")
+        assert rows == [(1, 10)]
+        # the dropped first attempt never reached the shard; the retry came
+        # in on a fresh connection with a fresh nonce
+        assert len(shard.peek_uuids) == 1
+        assert shard.hellos >= 2
+        assert ("send", "ctl", "shard0", 3, "blackhole") in plan.trace
+        ctl.close()
+    shard.kill()
+
+
+# -- mesh: partial send poisons the tick -------------------------------------
+
+
+@pytest.mark.smoke
+def test_partial_send_poisons_exchange_on_all_peers_smoke():
+    """Satellite: if a sender reaches peers 0..k-1 but not k, the
+    half-delivered (channel, tick) is poisoned everywhere — collectors fail
+    fast into the reform path instead of stalling out the full deadline."""
+    meshes = [WorkerMesh("127.0.0.1", 0) for _ in range(3)]
+    addrs = [m.addr for m in meshes]
+    threads = [
+        threading.Thread(target=m.form, args=(1, i, 3, 1, addrs))
+        for i, m in enumerate(meshes)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # sever proc0->proc2 from proc0's side: the send itself will fail
+    meshes[0]._conns[2].close()
+
+    errs: dict = {}
+
+    def worker(i):
+        try:
+            meshes[i].exchange(i, ("df", 0), 5, [None, None, None], timeout=30.0)
+        except MeshError as e:
+            errs[i] = str(e)
+
+    t0 = time.time()
+    ths = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    elapsed = time.time() - t0
+    # proc0 failed its send; proc1 was poisoned; proc2 saw the dead conn —
+    # nobody waited out the 30 s collect timeout on the half-delivered tick
+    assert 0 in errs and 1 in errs
+    assert len(errs) >= 2 and elapsed < 10.0
+    assert "poison" in errs[1] or "failed" in errs[1]
+    for m in meshes:
+        m.close()
+
+
+@pytest.mark.smoke
+def test_mesh_kill_mid_tick_then_reform_smoke():
+    """Seeded regression (a), in-process: kill one mesh endpoint mid-tick —
+    the survivor's exchange fails fast — then reform both at a bumped epoch
+    and verify the data plane is whole again."""
+    m0 = WorkerMesh("127.0.0.1", 0)
+    m1 = WorkerMesh("127.0.0.1", 0)
+    addrs = [m0.addr, m1.addr]
+    t = threading.Thread(target=m0.form, args=(1, 0, 2, 1, addrs))
+    t.start()
+    m1.form(1, 1, 2, 1, addrs)
+    t.join()
+
+    m1.close()  # the "kill": peer process gone mid-tick
+    with pytest.raises(MeshError):
+        m0.exchange(0, ("df", 0), 1, [None, None], timeout=5.0)
+
+    # restart + reform at a bumped epoch (the controller's recovery path)
+    m1b = WorkerMesh("127.0.0.1", 0)
+    addrs2 = [m0.addr, m1b.addr]
+    t = threading.Thread(target=m0.form, args=(2, 0, 2, 1, addrs2))
+    t.start()
+    m1b.form(2, 1, 2, 1, addrs2)
+    t.join()
+
+    got: dict = {}
+
+    def run(mesh, w):
+        got[w] = mesh.exchange(w, ("df", 0), 1, [f"p{w}->0", f"p{w}->1"])
+
+    ths = [threading.Thread(target=run, args=(m, w)) for m, w in ((m0, 0), (m1b, 1))]
+    for th in ths:
+        th.start()
+    for th in ths:
+        th.join()
+    assert got[0] == ["p0->0", "p1->0"]
+    assert got[1] == ["p0->1", "p1->1"]
+    m0.close()
+    m1b.close()
+
+
+# -- the degraded → restart → reform state machine ---------------------------
+
+
+@pytest.mark.smoke
+def test_heartbeat_degraded_restart_reform_smoke():
+    """Self-healing liveness end-to-end against scripted shards: missed
+    pongs mark the replica degraded, the restart hook revives the dead
+    shard, and the controller reforms at a bumped epoch — automatically."""
+    shards = [FakeShard(), FakeShard()]
+    revived: list = []
+
+    def restart(i):
+        revived.append(i)
+        if not shards[i]._alive:
+            shards[i].revive()
+
+    ctl = ShardedComputeController(
+        [s.addr for s in shards],
+        [("127.0.0.1", 0), ("127.0.0.1", 0)],
+        1,
+        "/tmp/unused-blob",
+        "/tmp/unused-cas",
+        epoch=1,
+        miss_threshold=2,
+        restart_shard=restart,
+        deadlines={p.Ping: 0.5, p.Hello: 2.0},
+    )
+    assert ctl.heartbeat_once() == [True, True]
+
+    shards[0].kill()
+    deadline = time.time() + 15.0
+    while ctl.epoch == 1 and time.time() < deadline:
+        ctl.heartbeat_once()
+        time.sleep(0.05)
+
+    assert ctl.epoch == 2 and not ctl.degraded
+    assert revived == [0]
+    kinds = [e[0] for e in ctl.events]
+    assert kinds.count("degraded") == 1
+    assert ("reform", 2) in ctl.events and ("recovered", 2) in ctl.events
+    # the healed replica serves again, end to end (each fake shard
+    # contributes its "partition" and the controller merges both)
+    assert ctl.heartbeat_once() == [True, True]
+    assert ctl.peek("df", "idx") == [(1, 10), (1, 10)]
+    ctl.close()
+    for s in shards:
+        s.kill()
+
+
+@pytest.mark.smoke
+def test_coordinator_replica_peek_skips_degraded_smoke(tmp_path):
+    """Graceful degradation at the adapter: while one replica reforms
+    (degraded), Coordinator.replica_peek serves from a survivor instead of
+    erroring — and fails with context only when nobody can answer."""
+    from materialize_tpu.adapter import Coordinator
+
+    class StubCtl:
+        def __init__(self, rows=None, degraded=False, boom=None):
+            self.rows = rows
+            self.degraded = degraded
+            self.boom = boom
+
+        def peek(self, dataflow_id, index_id, at=None):
+            if self.boom is not None:
+                raise self.boom
+            return list(self.rows)
+
+    coord = Coordinator(data_dir=str(tmp_path / "d"))
+    reforming = StubCtl(degraded=True)
+    broken = StubCtl(boom=ConnectionError("shard 1 hung up"))
+    healthy = StubCtl(rows=[(1, 2)])
+    coord._compute_replicas = {
+        "r_reforming": (reforming, None, False),
+        "r_broken": (broken, None, False),
+        "r_healthy": (healthy, None, False),
+    }
+    assert coord.replica_peek("df", "idx") == [(1, 2)]
+
+    coord._compute_replicas = {"r_reforming": (reforming, None, False)}
+    with pytest.raises(RuntimeError, match="degraded"):
+        coord.replica_peek("df", "idx")
+
+    coord._compute_replicas = {}
+    with pytest.raises(RuntimeError, match="no compute replicas"):
+        coord.replica_peek("df", "idx")
+
+
+@pytest.mark.smoke
+def test_amnesiac_shard_detected_by_mesh_epoch_smoke():
+    """A shard that restarts fast enough to answer pings is still detected:
+    its Pong carries mesh_epoch=-1 (no formed mesh), which counts as a miss
+    and drives the reform that rebuilds its partition."""
+    shards = [FakeShard(), FakeShard()]
+    ctl = ShardedComputeController(
+        [s.addr for s in shards],
+        [("127.0.0.1", 0), ("127.0.0.1", 0)],
+        1,
+        "/tmp/unused-blob",
+        "/tmp/unused-cas",
+        epoch=1,
+        miss_threshold=2,
+        deadlines={p.Ping: 0.5, p.Hello: 2.0},
+    )
+    # simulate kill+instant restart: alive, answering, but mesh-naive
+    shards[0].mesh_epoch = -1
+    deadline = time.time() + 15.0
+    while ctl.epoch == 1 and time.time() < deadline:
+        ctl.heartbeat_once()
+        time.sleep(0.05)
+    assert ctl.epoch == 2
+    assert shards[0].mesh_epoch == 2  # the reform re-formed its mesh
+    ctl.close()
+    for s in shards:
+        s.kill()

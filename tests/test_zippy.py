@@ -102,3 +102,151 @@ def test_zippy_random_actions(tmp_path, seed):
         if step % 5 == 4:
             z.validate()
     z.validate()
+
+
+# -- chaos tier: the same invariant under injected transport faults ----------
+
+
+class ZippyChaos:
+    """Zippy against a SHARDED replica under a seeded FaultPlan: randomized
+    ingest/retract plus chaos actions — kill-shard, partition-link,
+    delay-burst — validating after every action that the maintained index
+    equals a from-scratch recompute of the model (MV == recompute), i.e.
+    that self-healing recovery never loses or duplicates an update."""
+
+    GROUPS = 4
+
+    def __init__(self, tmp_path, seed: int, orch, ctl, bids):
+        self.rng = np.random.default_rng(seed)
+        self.orch = orch
+        self.ctl = ctl
+        self.bids = bids
+        self.t = 1  # next write tick
+        self.lower = 0  # the shard's current upper (CaS expected lower)
+        self.next_id = 0
+        self.live: dict[int, tuple] = {}  # id -> (group, price)
+
+    def _write(self, rows):
+        cols = {
+            f"c{i}": np.array([r[i] for r in rows], dtype=np.int64)
+            for i in range(5)
+        }
+        cols["times"] = np.full(len(rows), self.t, dtype=np.uint64)
+        cols["diffs"] = np.array([r[5] for r in rows], dtype=np.int64)
+        self.bids.compare_and_append(cols, self.lower, self.t + 1)
+        self.lower = self.t + 1
+        self.ctl.process_to(self.t + 1)
+        self.t += 1
+
+    def act_ingest(self):
+        n = int(self.rng.integers(1, 6))
+        rows = []
+        for _ in range(n):
+            rid = self.next_id
+            self.next_id += 1
+            g = int(self.rng.integers(0, self.GROUPS))
+            price = int(self.rng.integers(1, 500))
+            self.live[rid] = (g, price)
+            rows.append((rid, 7, 10 + g, price, 0, 1))
+        self._write(rows)
+
+    def act_retract(self):
+        if not self.live:
+            return
+        rid = int(self.rng.choice(list(self.live)))
+        g, price = self.live.pop(rid)
+        self._write([(rid, 7, 10 + g, price, 0, -1)])
+
+    def act_kill_shard(self):
+        """Kill a random shard process mid-stream, then OBSERVE the
+        self-heal: heartbeats detect, the restart hook respawns, the mesh
+        reforms at a bumped epoch — the test only watches the epoch move."""
+        import time
+
+        idx = int(self.rng.integers(0, self.ctl.n_processes))
+        e0 = self.ctl.epoch
+        self.orch.kill_replica("zippy_chaos", idx)
+        deadline = time.time() + 180.0
+        while (self.ctl.epoch == e0 or self.ctl.degraded) and time.time() < deadline:
+            time.sleep(0.25)
+        assert self.ctl.epoch > e0 and not self.ctl.degraded, (
+            f"kill of shard {idx} did not self-heal: epoch {self.ctl.epoch}, "
+            f"events {self.ctl.events}"
+        )
+
+    def act_partition_link(self, plan):
+        """Blackhole one ctl↔shard pair; reads must fail FAST (deadline,
+        not hang) while cut, and heal restores service with state intact."""
+        idx = int(self.rng.integers(0, self.ctl.n_processes))
+        plan.partition("ctl", f"shard{idx}")
+        with pytest.raises((ConnectionError, RuntimeError)):
+            self.ctl.peek("df1", "idx_bids_sum")
+        plan.heal()
+
+    def act_delay_burst(self, plan):
+        idx = int(self.rng.integers(0, self.ctl.n_processes))
+        plan.delay_burst("ctl", f"shard{idx}", int(self.rng.integers(2, 6)))
+
+    def validate(self):
+        want: dict = {}
+        for g, price in self.live.values():
+            s, n = want.get(g, (0, 0))
+            want[g] = (s + price, n + 1)
+        expected = sorted((10 + g, s, n) for g, (s, n) in want.items())
+        got = self.ctl.peek("df1", "idx_bids_sum")
+        assert got == expected, f"sharded MV diverged from recompute: {got} != {expected}"
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_zippy_chaos_sharded_replica(tmp_path):
+    import os
+
+    from materialize_tpu.cluster import FaultPlan, ShardedComputeController, faults
+    from materialize_tpu.cluster import protocol as p
+    from materialize_tpu.models import auction
+    from materialize_tpu.orchestrator import ProcessOrchestrator
+    from materialize_tpu.persist import FileBlob, FileConsensus, ShardMachine
+
+    seed = int(os.environ.get("FAULT_SEED", "11"))
+    print(f"chaos seed: replay with FAULT_SEED={seed}", flush=True)
+
+    blob_path = str(tmp_path / "blob")
+    cas_path = str(tmp_path / "cas")
+    bids = ShardMachine(FileBlob(blob_path), FileConsensus(cas_path), "bids")
+    orch = ProcessOrchestrator(cpu=True)
+    try:
+        addrs, mesh_addrs = orch.ensure_sharded_service(
+            "zippy_chaos", 2, workers_per_process=1
+        )
+        with faults.injected(FaultPlan(seed)) as plan:
+            ctl = ShardedComputeController(
+                addrs, mesh_addrs, 1, blob_path, cas_path, epoch=1,
+                restart_shard=orch.restarter("zippy_chaos"),
+                heartbeat_interval=0.5,
+                miss_threshold=2,
+                exchange_timeout=60.0,
+                retries=1,
+                deadlines={p.Peek: 5.0, p.Hello: 3.0},
+            )
+            ctl.create_dataflow(
+                "df1", auction.bids_sum_count(), {"bids": "bids"}, as_of=0
+            )
+            z = ZippyChaos(tmp_path, seed, orch, ctl, bids)
+            # one scripted pass through every chaos action, then a seeded mix
+            script = [
+                z.act_ingest,
+                lambda: z.act_delay_burst(plan),
+                z.act_ingest,
+                lambda: z.act_partition_link(plan),
+                z.act_kill_shard,
+                z.act_ingest,  # rides the self-heal (restart + reform)
+                z.act_retract,
+                z.act_ingest,
+            ]
+            for act in script:
+                act()
+                z.validate()
+            ctl.close()
+    finally:
+        orch.shutdown()
