@@ -95,7 +95,9 @@ def lsm_insert(lsm: LsmBatches, delta: UpdateBatch, tick, ratio: int = 4, since=
     levels = list(lsm.levels)
     overflow = jnp.asarray(False)
     n = len(levels)
-    tick = jnp.asarray(tick, dtype=jnp.int64)
+    # merge scheduling is mod-arithmetic on the tick counter: i32 is plenty
+    # (ticks are small) and keeps the compiled schedule 32-bit native
+    tick = jnp.asarray(tick).astype(jnp.int32)
 
     # merges, deepest first (uses the pre-merge contents of lower levels)
     for i in range(n - 2, -1, -1):
@@ -198,7 +200,9 @@ def accum_lsm_insert(lsm: LsmAccums, contrib: AccumState, tick, ratio: int = 4):
     levels = list(lsm.levels)
     overflow = jnp.asarray(False)
     n = len(levels)
-    tick = jnp.asarray(tick, dtype=jnp.int64)
+    # merge scheduling is mod-arithmetic on the tick counter: i32 is plenty
+    # (ticks are small) and keeps the compiled schedule 32-bit native
+    tick = jnp.asarray(tick).astype(jnp.int32)
     for i in range(n - 2, -1, -1):
         period = ratio ** (i + 1)
         do_merge = (tick % period) == 0

@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 import jax.numpy as jnp
 
 from ..ops.consolidate import advance_times, consolidate, merge_consolidate
-from ..repr.batch import UpdateBatch, bucket_cap
+from ..repr.batch import UpdateBatch, bucket_cap, device_time_scalar
 from ..repr.hashing import hash_columns
 
 
@@ -72,7 +72,7 @@ class Arrangement:
             a = self.batches.pop()
             # spine batches are consolidate outputs (canonical order), so the
             # O(n) searchsorted merge replaces the full re-sort
-            merged = merge_consolidate(a, b, since=jnp.uint64(self.since))
+            merged = merge_consolidate(a, b, since=device_time_scalar(self.since))
             self.batches.append(merged.with_capacity(bucket_cap(a.cap + b.cap)))
 
     def compact(self, since: int) -> None:

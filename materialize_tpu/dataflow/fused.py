@@ -39,7 +39,7 @@ from ..arrangement.lsm import (
     lsm_join,
 )
 from ..arrangement.spine import Arrangement, arrange_batch
-from ..ops.consolidate import advance_times, consolidate
+from ..ops.consolidate import advance_times, compact_to, consolidate
 from ..ops.join import join_materialize, join_total
 from ..ops.reduce import (
     AccumState,
@@ -47,12 +47,24 @@ from ..ops.reduce import (
     _emit_output,
     consolidate_accums,
 )
+from ..ops.search import searchsorted
 from ..ops.topk import _gather_materialize, distinct_keys, negate, topk_select
-from ..repr.batch import UpdateBatch, bucket_cap
+from ..repr.batch import (
+    PAD_TIME,
+    UpdateBatch,
+    bucket_cap,
+    device_time_scalar,
+    to_device_time,
+)
 from . import plan as lir
 from .runtime import ERR_DTYPES, materialize_counts
 
 I64 = np.dtype(np.int64)
+
+# error-stream compaction buffer: errors are almost always empty, so the
+# concatenated per-operator error streams compact here before their
+# canonicalizing sort (overflow of REAL error rows trips the tick retry)
+_ERR_COMPACT_CAP = 8192
 
 
 class FusedUnsupported(Exception):
@@ -376,14 +388,28 @@ class FusedCompiler:
         raise FusedUnsupported(type(e).__name__)
 
     def _union_outs(self, outs: list, out_cap: int, ctx: _Ctx) -> UpdateBatch:
-        """Concat + consolidate partial outputs, then shrink to `out_cap`.
+        """Concat partials, O(n)-compact live rows, sort small, THEN shrink.
 
-        Consolidation compacts live rows to the front, so the shrink is
-        lossless iff live ≤ out_cap — checked by an overflow flag (a tripped
-        flag aborts the tick; the host retries with doubled caps)."""
+        The concatenation of K per-level join outputs is mostly padding;
+        sorting it at full width was the mid-cap sort tail of the r5 profile
+        (PROFILE_r5.md §3). `compact_to` moves the live rows into one small
+        buffer with a cumsum+scatter (no sort), so the canonicalizing sort
+        runs at 2×out_cap instead of K× that. The 2× headroom exists because
+        raw live rows are a MULTISET count: +/- pairs and duplicate rows from
+        different join levels (normal under insert+delete churn) annihilate
+        in the consolidate below, so compacting straight to out_cap would
+        trip the retry flag on ticks whose consolidated output fits. Real
+        overflow stays loud — compact_to flags live > 2×out_cap, and the
+        final shrink checks the post-consolidation count exactly like the
+        pre-compaction path did (a tripped flag aborts the tick; the host
+        retries with doubled caps)."""
         acc = outs[0]
         for p in outs[1:]:
             acc = UpdateBatch.concat(acc, p)
+        mid_cap = 2 * out_cap
+        if acc.cap > mid_cap:
+            acc, over = compact_to(acc, mid_cap)
+            ctx.overflow.append(over)
         merged = consolidate(acc)
         if merged.cap <= out_cap:
             return merged
@@ -498,7 +524,6 @@ class FusedCompiler:
     def _emit_multiplicity(self, e, ctx: _Ctx, key_cols, mode: str) -> UpdateBatch:
         """Distinct / Threshold: multiplicity map over a per-row count table."""
         from ..ops.threshold import _multiplicity
-        from ..repr.batch import PAD_TIME
         from ..repr.hashing import PAD_HASH
 
         _kind, path = self._emitters[id(e)]
@@ -515,7 +540,7 @@ class FusedCompiler:
         new_n = old_n + contrib.nrows
         out_d = _multiplicity(mode, new_n) - _multiplicity(mode, old_n)
         live = contrib.live & (out_d != 0)
-        t = jnp.asarray(ctx.time, dtype=jnp.uint64)
+        t = to_device_time(ctx.time)
         out = UpdateBatch(
             hashes=jnp.where(live, contrib.hashes, PAD_HASH),
             keys=(),
@@ -559,8 +584,8 @@ def _gather_lsm(probes: UpdateBatch, lsm: LsmBatches, cap: int, time):
     parts = []
     overflow = jnp.asarray(False)
     for level in lsm.levels:
-        lo = jnp.searchsorted(level.hashes, probes.hashes, side="left")
-        hi = jnp.searchsorted(level.hashes, probes.hashes, side="right")
+        lo = searchsorted(level.hashes, probes.hashes, side="left")
+        hi = searchsorted(level.hashes, probes.hashes, side="right")
         overflow = overflow | (
             jnp.sum(jnp.where(probes.live, hi - lo, 0)) > cap
         )
@@ -568,7 +593,7 @@ def _gather_lsm(probes: UpdateBatch, lsm: LsmBatches, cap: int, time):
     acc = parts[0]
     for p in parts[1:]:
         acc = UpdateBatch.concat(acc, p)
-    return consolidate(advance_times(acc, jnp.asarray(time, jnp.uint64))), overflow
+    return consolidate(advance_times(acc, time)), overflow
 
 
 def _project_cols(batch: UpdateBatch, perm) -> UpdateBatch:
@@ -711,9 +736,20 @@ class FusedDataflow:
             )
             outs = self.compiler.emit_tick(ctx)
             if ctx.errs:
+                # error streams are almost always empty: O(n)-compact the
+                # concat into a small buffer before the canonicalizing sort;
+                # an overflow of real error rows trips the retry flag (loud,
+                # never silently dropped). The cap scales with the retry
+                # ladder: error-row count is data-dependent (doubling the
+                # operator caps can't shrink it), so a fixed cap would make
+                # a >cap error burst retry forever.
+                err_cap = _ERR_COMPACT_CAP * self._scale
                 errs = ctx.errs[0]
                 for p in ctx.errs[1:]:
                     errs = UpdateBatch.concat(errs, p)
+                if errs.cap > err_cap:
+                    errs, err_over = compact_to(errs, err_cap)
+                    ctx.overflow.append(err_over)
                 errs = consolidate(errs)
             else:
                 errs = UpdateBatch.empty(8, (), ERR_DTYPES)
@@ -817,7 +853,7 @@ class FusedDataflow:
             deltas[cid] = self._const_delta(cid, c, tick, delta_cap)
 
         state2, outs, errs, over, counts = self._tick(
-            self.state, deltas, np.uint64(tick), np.uint64(self.since)
+            self.state, deltas, device_time_scalar(tick), device_time_scalar(self.since)
         )
         if bool(np.asarray(over).any()):
             # lossless retry: drop results, double capacities, re-run the

@@ -819,19 +819,23 @@ class TemporalFilterNode(Node):
 
     def _windows(self, batch: UpdateBatch):
         from ..expr.scalar import eval_expr
-        from ..repr.batch import PAD_TIME
+        from ..repr.batch import MAX_DEVICE_TIME, PAD_TIME, TIME_DTYPE
 
         cols = list(batch.vals)
         n = batch.cap
-        start = jnp.zeros((n,), dtype=jnp.uint64)
+        # event times come from DATA values: clamp into [0, MAX_DEVICE_TIME]
+        # so a huge bound saturates at "effectively forever" and can never
+        # collide with the PAD_TIME padding sentinel (end == PAD_TIME means
+        # "no expiry" below and must stay unreachable for real bounds)
+        start = jnp.zeros((n,), dtype=TIME_DTYPE)
         for e in self.lowers:
             v, _err = eval_expr(e, cols, n)
-            v = jnp.maximum(v, 0).astype(jnp.uint64)
+            v = jnp.clip(v, 0, MAX_DEVICE_TIME).astype(TIME_DTYPE)
             start = jnp.maximum(start, v)
-        end = jnp.full((n,), PAD_TIME, dtype=jnp.uint64)
+        end = jnp.full((n,), PAD_TIME, dtype=TIME_DTYPE)
         for e in self.uppers:
             v, _err = eval_expr(e, cols, n)
-            v = jnp.maximum(v, 0).astype(jnp.uint64)
+            v = jnp.clip(v, 0, MAX_DEVICE_TIME).astype(TIME_DTYPE)
             end = jnp.minimum(end, v)
         # a row's events: +d at max(start, row time), −d at end (if finite)
         start = jnp.maximum(start, batch.times)
@@ -872,7 +876,9 @@ class TemporalFilterNode(Node):
         if self.pending is None:
             return None if errs is None else (None, errs)
         # flush events due at or before this tick
-        due = self.pending.live & (self.pending.times <= jnp.uint64(tick))
+        from ..repr.batch import device_time_scalar
+
+        due = self.pending.live & (self.pending.times <= device_time_scalar(tick))
         n_due = int(jnp.sum(due))
         if n_due == 0:
             out = None
@@ -1031,7 +1037,9 @@ def peek_error_message(index_id: str, acc: dict) -> str:
 def _retime(batch: UpdateBatch, tick: int) -> UpdateBatch:
     """Overwrite live rows' times with the outer tick (iteration timestamps
     are scope-private, like the inner coordinate of a product timestamp)."""
-    t = jnp.asarray(tick, dtype=jnp.uint64)
+    from ..repr.batch import to_device_time
+
+    t = to_device_time(tick)
     live = batch.live
     return UpdateBatch(
         batch.hashes,
@@ -1448,7 +1456,9 @@ def _truncate_until(b: Optional[UpdateBatch], until: int) -> Optional[UpdateBatc
     from ..repr.batch import PAD_TIME
     from ..repr.hashing import PAD_HASH
 
-    keep = b.times < jnp.uint64(until)
+    # `until` is a host u64-domain bound; clamp to PAD_TIME so an unbounded
+    # until keeps every live row (live times are < PAD_TIME by construction)
+    keep = b.times < np.uint32(min(int(until), int(PAD_TIME)))
     return UpdateBatch(
         jnp.where(keep, b.hashes, PAD_HASH),
         b.keys,

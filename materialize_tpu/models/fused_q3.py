@@ -350,16 +350,16 @@ def hydration_output(state: Q3State, time) -> UpdateBatch:
 
     top = state.accum.levels[-1]
     live = top.live
-    t = jnp.asarray(time, dtype=jnp.uint64)
-    from ..repr.batch import PAD_TIME
+    from ..repr.batch import DIFF_DTYPE, PAD_TIME, to_device_time
     from ..repr.hashing import PAD_HASH
 
+    t = to_device_time(time)
     return UpdateBatch(
         hashes=jnp.where(live, top.hashes, PAD_HASH),
         keys=(),
         vals=tuple(top.keys) + tuple(top.accums),
         times=jnp.where(live, t, PAD_TIME),
-        diffs=live.astype(jnp.int64),
+        diffs=live.astype(DIFF_DTYPE),
     )
 
 

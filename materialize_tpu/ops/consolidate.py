@@ -22,6 +22,7 @@ import jax.numpy as jnp
 
 from ..repr.batch import PAD_TIME, UpdateBatch
 from ..repr.hashing import PAD_HASH
+from .search import searchsorted2, sort_perm
 
 
 def row_equal_prev(cols) -> jnp.ndarray:
@@ -38,14 +39,17 @@ def row_equal_prev(cols) -> jnp.ndarray:
     return jnp.concatenate([jnp.zeros((1,), dtype=jnp.bool_), eq])
 
 
-def pack_sort_key(batch: UpdateBatch) -> jnp.ndarray:
-    """The canonical u64 ordering key: (key_hash << 32) | row_hash.
+def pack_sort_key(batch: UpdateBatch) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The canonical ordering key as a (key_hash, row_hash) u32 pair.
 
     row_hash is a u32 content hash of the val columns, so duplicate rows
-    inside one key group land adjacent and annihilate. PAD_HASH rows pack to
-    >= 0xFFFFFFFF_00000000, above every live key (hash_columns clamps live
-    hashes below PAD_HASH), so padding sorts last. A batch sorted by this key
-    is sorted by key hash — exactly what binary-search probes need.
+    inside one key group land adjacent and annihilate. The pair orders
+    exactly like the former packed u64 `(key_hash << 32) | row_hash` — two
+    native u32 sort operands instead of one split u64 (the TPU VPU is a
+    32-bit machine; u64 sort operands cost 2× in X64SplitLow pairs). PAD_HASH
+    rows carry the maximal hi key (hash_columns clamps live hashes below
+    PAD_HASH), so padding sorts last. A batch sorted by this pair is sorted
+    by key hash — exactly what binary-search probes need.
     """
     from ..repr.hashing import hash_columns
 
@@ -53,9 +57,7 @@ def pack_sort_key(batch: UpdateBatch) -> jnp.ndarray:
         row_hash = hash_columns(batch.vals)
     else:
         row_hash = jnp.zeros_like(batch.hashes)
-    return (batch.hashes.astype(jnp.uint64) << jnp.uint64(32)) | row_hash.astype(
-        jnp.uint64
-    )
+    return batch.hashes, row_hash
 
 
 def _stable_partition_perm(live: jnp.ndarray) -> jnp.ndarray:
@@ -150,9 +152,8 @@ def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
     stay split across entries, and every consumer treats a batch as a
     multiset of (row, time, diff) updates (operators are linear in diff), so
     only perfect annihilation (a capacity concern, not correctness) needs
-    adjacency. The time operand is the LOW 32 bits of the u64 time: distinct
-    times 2^32 apart may interleave within a row's run, splitting it — again
-    a capacity concern only, and impossible for tick-counter times.
+    adjacency. The time operand is the u32 device time view directly — three
+    native u32 sort operands total, no 64-bit operand anywhere in the sort.
 
     Padding rows sort last (PAD_HASH) and keep diff 0, so they fold into one
     run that is masked back out. Output has the same capacity.
@@ -166,8 +167,8 @@ def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
     everywhere (consumers test diff != 0) but DO widen join candidate ranges,
     so arrangements should stay compacted.
     """
-    packed = pack_sort_key(batch)
-    order = jnp.lexsort((batch.times.astype(jnp.uint32), packed))
+    k_hi, k_lo = pack_sort_key(batch)
+    order = sort_perm((batch.times, k_lo, k_hi))
     return _consolidate_sorted(batch.permute(order), compact)
 
 
@@ -192,12 +193,16 @@ def merge_consolidate(
     still cancel once `since` passes both (times then collapse equal), so
     this costs capacity transiently, never correctness (multiset semantics).
     """
-    ka = pack_sort_key(a)
-    kb = pack_sort_key(b)
+    ka_hi, ka_lo = pack_sort_key(a)
+    kb_hi, kb_lo = pack_sort_key(b)
     na, nb = a.cap, b.cap
-    pa = jnp.arange(na) + jnp.searchsorted(kb, ka, side="left")
-    pb = jnp.arange(nb) + jnp.searchsorted(ka, kb, side="right")
-    pos = jnp.concatenate([pa, pb]).astype(jnp.int32)
+    pa = jnp.arange(na, dtype=jnp.int32) + searchsorted2(
+        kb_hi, kb_lo, ka_hi, ka_lo, side="left"
+    )
+    pb = jnp.arange(nb, dtype=jnp.int32) + searchsorted2(
+        ka_hi, ka_lo, kb_hi, kb_lo, side="right"
+    )
+    pos = jnp.concatenate([pa, pb])
     iota = jnp.arange(na + nb, dtype=jnp.int32)
     perm = (pos * 0).at[pos].set(iota)
     cat = UpdateBatch.concat(a, b).permute(perm)
@@ -220,7 +225,9 @@ def advance_times(batch: UpdateBatch, since: jnp.ndarray):
     (reference: allow_compaction, src/compute/src/compute_state.rs:732). After
     advancing, `consolidate` can cancel updates that now share a timestamp.
     """
-    since = jnp.asarray(since, dtype=jnp.uint64)
+    from ..repr.batch import to_device_time
+
+    since = to_device_time(since)
     is_pad = batch.times == PAD_TIME
     new_times = jnp.where(is_pad, batch.times, jnp.maximum(batch.times, since))
     return UpdateBatch(batch.hashes, batch.keys, batch.vals, new_times, batch.diffs)

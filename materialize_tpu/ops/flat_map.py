@@ -24,8 +24,9 @@ import jax
 import jax.numpy as jnp
 
 from ..expr.scalar import EvalErr, eval_expr3
-from ..repr.batch import PAD_TIME, UpdateBatch
+from ..repr.batch import I64_DTYPE, PAD_TIME, UpdateBatch
 from ..repr.hashing import PAD_HASH
+from .search import searchsorted
 
 
 def _series_bounds(batch: UpdateBatch, exprs):
@@ -35,9 +36,9 @@ def _series_bounds(batch: UpdateBatch, exprs):
     lo, lnull, lerr = eval_expr3(exprs[0], cols, n)
     hi, hnull, herr = eval_expr3(exprs[1], cols, n)
     st, snull, serr = eval_expr3(exprs[2], cols, n)
-    lo = lo.astype(jnp.int64)
-    hi = hi.astype(jnp.int64)
-    st = st.astype(jnp.int64)
+    lo = lo.astype(I64_DTYPE)
+    hi = hi.astype(I64_DTYPE)
+    st = st.astype(I64_DTYPE)
     null = lnull | hnull | snull
     err = jnp.maximum(jnp.maximum(lerr, herr), serr)
     err = jnp.where(null, 0, err)
@@ -63,11 +64,11 @@ def flat_map_materialize(batch: UpdateBatch, exprs, out_cap: int):
     """Returns (out, errs, overflow): out rows = input vals ++ series value."""
     lo, st, count, err = _series_bounds(batch, exprs)
     cum = jnp.cumsum(count)
-    total = cum[-1] if count.shape[0] > 0 else jnp.int64(0)
+    total = cum[-1] if count.shape[0] > 0 else jnp.zeros((), dtype=cum.dtype)
     over = total > out_cap
 
     j = jnp.arange(out_cap, dtype=cum.dtype)
-    pi = jnp.searchsorted(cum, j, side="right")
+    pi = searchsorted(cum, j, side="right")
     pi = jnp.minimum(pi, batch.cap - 1)
     prev = jnp.where(pi > 0, cum[pi - 1], 0)
     off = j - prev
@@ -86,7 +87,7 @@ def flat_map_materialize(batch: UpdateBatch, exprs, out_cap: int):
     errs = UpdateBatch(
         hashes=jnp.where(err_mask, jnp.zeros_like(batch.hashes), PAD_HASH),
         keys=(),
-        vals=(err.astype(jnp.int64),),
+        vals=(err.astype(I64_DTYPE),),
         times=jnp.where(err_mask, batch.times, PAD_TIME),
         diffs=jnp.where(err_mask, batch.diffs, 0),
     )

@@ -28,12 +28,15 @@ import jax.numpy as jnp
 
 from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
 from ..repr.hashing import PAD_HASH
+from .search import searchsorted
 
 
 @jax.jit
 def _probe_ranges(probe: UpdateBatch, arr: UpdateBatch):
-    lo = jnp.searchsorted(arr.hashes, probe.hashes, side="left")
-    hi = jnp.searchsorted(arr.hashes, probe.hashes, side="right")
+    # branchless fixed-depth binary search (ops/search.py): no while loop,
+    # i32 positions — the probe kernel is pure gather/compare/select
+    lo = searchsorted(arr.hashes, probe.hashes, side="left")
+    hi = searchsorted(arr.hashes, probe.hashes, side="right")
     counts = jnp.where(probe.live, hi - lo, 0)
     return lo, counts
 
@@ -56,12 +59,12 @@ def join_materialize(
     (host checks via `join_total`).
     """
     lo, counts = _probe_ranges(probe, arr)
-    cum = jnp.cumsum(counts)  # inclusive
-    total = cum[-1] if counts.shape[0] > 0 else jnp.int64(0)
+    cum = jnp.cumsum(counts)  # inclusive, i32 (counts bounded by capacities)
+    total = cum[-1] if counts.shape[0] > 0 else jnp.zeros((), dtype=jnp.int32)
 
     j = jnp.arange(out_cap, dtype=cum.dtype)
     # probe row owning output slot j: first i with cum[i] > j
-    pi = jnp.searchsorted(cum, j, side="right")
+    pi = searchsorted(cum, j, side="right")
     pi = jnp.minimum(pi, probe.cap - 1)
     prev = jnp.where(pi > 0, cum[pi - 1], 0)
     ai = lo[pi] + (j - prev)

@@ -22,8 +22,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..expr.scalar import ScalarExpr, eval_expr
-from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
+from ..repr.batch import (
+    DIFF_DTYPE,
+    I64_DTYPE,
+    PAD_TIME,
+    UpdateBatch,
+    bucket_cap,
+    to_device_time,
+)
 from ..repr.hashing import PAD_HASH, hash_columns
+from .search import searchsorted, searchsorted2, sort_perm
 
 # Fast-path scan width for hash-bucket lookups. u32 row hashes make small
 # buckets routine at scale (birthday collisions from ~2^16 keys), so lookups
@@ -44,7 +52,7 @@ class AccumState:
     hashes: jnp.ndarray  # u32 [cap], PAD_HASH = padding
     keys: tuple  # key columns [cap]
     accums: tuple  # one accumulator column per aggregate [cap]
-    nrows: jnp.ndarray  # i64 [cap] — group size (sum of diffs)
+    nrows: jnp.ndarray  # i64 (DIFF_DTYPE) [cap] — group size (sum of diffs)
 
     def tree_flatten(self):
         return (self.hashes, self.keys, self.accums, self.nrows), None
@@ -70,7 +78,7 @@ class AccumState:
             hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint32),
             keys=tuple(jnp.zeros((cap,), dtype=dt) for dt in key_dtypes),
             accums=tuple(jnp.zeros((cap,), dtype=dt) for dt in accum_dtypes),
-            nrows=jnp.zeros((cap,), dtype=jnp.int64),
+            nrows=jnp.zeros((cap,), dtype=DIFF_DTYPE),
         )
 
     @staticmethod
@@ -140,15 +148,17 @@ def agg_out_dtype(a: AggregateExpr) -> np.dtype:
     return np.dtype(np.float32) if a.fixed_scale else np.dtype(a.accum_dtype)
 
 
-def _accum_pack(s: AccumState) -> jnp.ndarray:
-    """Canonical u64 ordering key of an accum table: (key_hash<<32) | mix.
+def _accum_pack(s: AccumState) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Canonical ordering key of an accum table as a (key_hash, mix) u32 pair.
 
-    Sorting by this (with the raw keys as tiebreak in the sort path) makes
-    two independently consolidated tables mergeable by a single searchsorted
-    pass: rows from different tables that agree on the packed key but hold
-    different keys need a 2^-64 double-collision, which
-    merge_consolidate_accums detects and flags rather than mis-merging.
-    PAD rows pack above every live key (hash_columns clamps below PAD_HASH).
+    Orders exactly like the former packed u64 `(key_hash << 32) | mix`, as
+    two native u32 operands. Sorting by this (with the raw keys as tiebreak
+    in the sort path) makes two independently consolidated tables mergeable
+    by a single two-key searchsorted pass: rows from different tables that
+    agree on the full pair but hold different keys need a 2^-64
+    double-collision, which merge_consolidate_accums detects and flags
+    rather than mis-merging. PAD rows carry the maximal hi key
+    (hash_columns clamps below PAD_HASH).
     """
     from ..repr.hashing import mix_columns
 
@@ -156,7 +166,7 @@ def _accum_pack(s: AccumState) -> jnp.ndarray:
         mix = mix_columns(s.keys)
     else:
         mix = jnp.zeros_like(s.hashes)
-    return (s.hashes.astype(jnp.uint64) << jnp.uint64(32)) | mix.astype(jnp.uint64)
+    return s.hashes, mix
 
 
 def _consolidate_accums_sorted(s: AccumState):
@@ -212,8 +222,8 @@ def consolidate_accums(s: AccumState) -> AccumState:
     """Order by (packed key, keys), sum accumulators of equal keys, drop
     empty groups. Keys tiebreak the sort, so equal keys are always adjacent
     here (no collision exposure on this path)."""
-    packed = _accum_pack(s)
-    order = jnp.lexsort((*(k for k in reversed(s.keys)), packed))
+    p_hi, p_lo = _accum_pack(s)
+    order = sort_perm((*(k for k in reversed(s.keys)), p_lo, p_hi))
     s = AccumState(
         s.hashes[order],
         tuple(k[order] for k in s.keys),
@@ -233,12 +243,16 @@ def merge_consolidate_accums(a: AccumState, b: AccumState):
     `dup` is the loud-failure flag for the 2^-64 packed-key double collision
     (see _accum_pack) — treated like a capacity overflow by callers, never a
     silent mis-aggregation."""
-    ka = _accum_pack(a)
-    kb = _accum_pack(b)
+    ka_hi, ka_lo = _accum_pack(a)
+    kb_hi, kb_lo = _accum_pack(b)
     na, nb = a.cap, b.cap
-    pa = jnp.arange(na) + jnp.searchsorted(kb, ka, side="left")
-    pb = jnp.arange(nb) + jnp.searchsorted(ka, kb, side="right")
-    pos = jnp.concatenate([pa, pb]).astype(jnp.int32)
+    pa = jnp.arange(na, dtype=jnp.int32) + searchsorted2(
+        kb_hi, kb_lo, ka_hi, ka_lo, side="left"
+    )
+    pb = jnp.arange(nb, dtype=jnp.int32) + searchsorted2(
+        ka_hi, ka_lo, kb_hi, kb_lo, side="right"
+    )
+    pos = jnp.concatenate([pa, pb])
     iota = jnp.arange(na + nb, dtype=jnp.int32)
     perm = (pos * 0).at[pos].set(iota)
     cat = AccumState.concat(a, b)
@@ -307,7 +321,7 @@ def _contributions(delta: UpdateBatch, key_cols: tuple[int, ...], aggs):
     errs = UpdateBatch(
         hashes=jnp.where(err_mask, jnp.zeros_like(delta.hashes), PAD_HASH),
         keys=(),
-        vals=(err.astype(jnp.int64),),
+        vals=(err.astype(I64_DTYPE),),
         times=jnp.where(err_mask, delta.times, PAD_TIME),
         diffs=jnp.where(err_mask, delta.diffs, 0),
     )
@@ -325,13 +339,18 @@ def lookup_accums(state: AccumState, probe: AccumState):
     unsound and callers MUST surface an error rather than use it (the
     detect-and-error stance; silently treating the group as absent would be
     a wrong answer)."""
-    lo = jnp.searchsorted(state.hashes, probe.hashes, side="left")
-    hi = jnp.searchsorted(state.hashes, probe.hashes, side="right")
+    lo = searchsorted(state.hashes, probe.hashes, side="left")
+    hi = searchsorted(state.hashes, probe.hashes, side="right")
     from ..repr.hashing import value_view
 
     def scan(width: int):
-        def body(off, carry):
-            found, idx = carry
+        # unrolled Python loop, NOT fori_loop: `width` is static, so the
+        # scan is `width` branchless gather/compare steps — no while loop in
+        # the compiled tick, fully vectorized on XLA:CPU and the TPU VPU
+        # (and no shard_map carry-varyingness pitfalls to manage).
+        found = probe.live & False
+        idx = lo * 0
+        for off in range(width):
             cand = jnp.clip(lo + off, 0, state.cap - 1)
             eq = (lo + off) < hi
             for pk, sk in zip(probe.keys, state.keys):
@@ -339,14 +358,8 @@ def lookup_accums(state: AccumState, probe: AccumState):
                 eq = eq & (pv == sv[cand])
             eq = eq & probe.live
             idx = jnp.where(eq & ~found, cand, idx)
-            return found | eq, idx
-
-        # Derive the carry init from already-traced operands so its varying
-        # manual axes match the body output under shard_map (a literal
-        # jnp.zeros init is unvarying while the body result varies over the
-        # mesh axis, which fails fori_loop's carry type check).
-        init = (probe.live & False, lo * 0)
-        return jax.lax.fori_loop(0, width, body, init)
+            found = found | eq
+        return found, idx
 
     found, idx = scan(_MAX_HASH_COLLISIONS)
     narrow_missed = jnp.any(
@@ -369,7 +382,7 @@ def lookup_accums(state: AccumState, probe: AccumState):
 # 8x headroom over any single additional contribution (advisor r4: the
 # engine's error model is loud failure, never silent mis-aggregation; the
 # reference's Accum::Float carries i128 headroom instead)
-_ACCUM_OVERFLOW_BOUND = np.int64(1) << np.int64(60)
+_ACCUM_OVERFLOW_BOUND = 1 << 60
 
 
 def accum_overflow_errs(
@@ -384,7 +397,7 @@ def accum_overflow_errs(
     scales = tuple(getattr(a, "fixed_scale", 0) for a in aggs)
     if not any(scales):
         return None
-    t = jnp.asarray(time, dtype=jnp.uint64)
+    t = to_device_time(time)
     over = contrib.count() < 0  # varying-typed False
     for (c, o, s) in zip(contrib.accums, old_accums, scales):
         if not s:
@@ -395,13 +408,13 @@ def accum_overflow_errs(
     over = over & contrib.live
     from ..expr.scalar import EvalErr
 
-    code = jnp.asarray(int(EvalErr.NUMERIC_OVERFLOW), jnp.int64)
+    code = jnp.asarray(int(EvalErr.NUMERIC_OVERFLOW), I64_DTYPE)
     return UpdateBatch(
         hashes=jnp.where(over, jnp.zeros_like(contrib.hashes), PAD_HASH),
         keys=(),
         vals=(jnp.where(over, code, 0),),
         times=jnp.where(over, t, PAD_TIME),
-        diffs=jnp.where(over, 1, 0).astype(jnp.int64),
+        diffs=jnp.where(over, 1, 0).astype(DIFF_DTYPE),
     )
 
 
@@ -410,14 +423,14 @@ def collision_errs(probe: AccumState, missed, time) -> UpdateBatch:
     """Error-collection rows for unresolved hash-bucket probes."""
     from ..expr.scalar import EvalErr
 
-    t = jnp.asarray(time, dtype=jnp.uint64)
-    code = jnp.asarray(int(EvalErr.HASH_COLLISION_EXHAUSTED), jnp.int64)
+    t = to_device_time(time)
+    code = jnp.asarray(int(EvalErr.HASH_COLLISION_EXHAUSTED), I64_DTYPE)
     return UpdateBatch(
         hashes=jnp.where(missed, jnp.zeros_like(probe.hashes), PAD_HASH),
         keys=(),
         vals=(jnp.where(missed, code, 0),),
         times=jnp.where(missed, t, PAD_TIME),
-        diffs=jnp.where(missed, 1, 0).astype(jnp.int64),
+        diffs=jnp.where(missed, 1, 0).astype(DIFF_DTYPE),
     )
 
 
@@ -464,13 +477,13 @@ def _emit_output(
     vals = tuple(interleave(k, k) for k in delta_keys.keys) + tuple(
         interleave(o, n) for o, n in zip(old_accums, new_accums)
     )
-    t = jnp.asarray(time, dtype=jnp.uint64)
+    t = to_device_time(time)
     times = interleave(
         jnp.where(old_present, t, PAD_TIME), jnp.where(new_present, t, PAD_TIME)
     )
     diffs = interleave(
-        jnp.where(old_present, -1, 0).astype(jnp.int64),
-        jnp.where(new_present, 1, 0).astype(jnp.int64),
+        jnp.where(old_present, -1, 0).astype(DIFF_DTYPE),
+        jnp.where(new_present, 1, 0).astype(DIFF_DTYPE),
     )
     return UpdateBatch(hashes, (), vals, times, diffs)
 

@@ -1,0 +1,99 @@
+"""Branchless fixed-depth binary search + 32-bit sort plumbing.
+
+`jnp.searchsorted` lowers to a vmapped `lax.while_loop` — one sequential,
+data-dependent loop per probe kernel. The r5 CPU profile counted ~45 such
+loops per Q3 tick, and on the TPU VPU data-dependent control flow defeats
+vectorization entirely. Every probe in this engine searches an array whose
+length is STATIC (pow2-bucketed capacities), so the loop is replaced by a
+fixed-depth unrolled binary search: ceil(log2(n)) + 1 gather/compare/select
+steps with no control flow at all — the accelerator-native scan formulation
+(cf. arXiv:2505.15112) and the gather-structured probe shape of
+hash-partitioned join hardware (cf. arXiv:1905.13376).
+
+Invariant maintained per step: the insertion point lies in [pos, pos + cur];
+each step compares one gathered element and halves `cur`. All positions are
+i32 (capacities are far below 2^31), so probe kernels carry no 64-bit index
+arithmetic.
+
+`sort_perm` is the 32-bit `jnp.lexsort`: under x64, jnp's argsort/lexsort
+carry an i64 iota operand through the sort — a 64-bit operand the TPU splits
+into u32 pairs. `sort_perm` threads an explicit i32 iota instead, so compiled
+ticks contain no 64-bit sort operands at all.
+"""
+
+from __future__ import annotations
+
+import jax.lax as lax
+import jax.numpy as jnp
+
+
+def _pred(a_elem: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
+    return (a_elem < q) if side == "left" else (a_elem <= q)
+
+
+def _pred2(a_hi, a_lo, q_hi, q_lo, side: str) -> jnp.ndarray:
+    """(hi, lo) pair comparison: a < q (left) / a <= q (right) on the packed
+    64-bit order, evaluated entirely in 32-bit lanes."""
+    if side == "left":
+        return (a_hi < q_hi) | ((a_hi == q_hi) & (a_lo < q_lo))
+    return (a_hi < q_hi) | ((a_hi == q_hi) & (a_lo <= q_lo))
+
+
+def searchsorted(a: jnp.ndarray, q: jnp.ndarray, side: str = "left") -> jnp.ndarray:
+    """np.searchsorted over a sorted array of STATIC length, branchless.
+
+    Returns i32 insertion points in [0, n]. ceil(log2(n)) + 1 unrolled
+    steps; no data-dependent control flow (vectorizes on XLA:CPU and the
+    TPU VPU alike).
+    """
+    n = int(a.shape[0])
+    pos = jnp.zeros(q.shape, dtype=jnp.int32)
+    cur = n
+    while cur > 1:
+        half = cur >> 1
+        mid = pos + (half - 1)  # compare a[pos + half - 1]
+        pos = jnp.where(_pred(a[mid], q, side), pos + half, pos)
+        cur -= half
+    return pos + _pred(a[pos], q, side).astype(jnp.int32)
+
+
+def searchsorted2(
+    a_hi: jnp.ndarray,
+    a_lo: jnp.ndarray,
+    q_hi: jnp.ndarray,
+    q_lo: jnp.ndarray,
+    side: str = "left",
+) -> jnp.ndarray:
+    """Two-key branchless searchsorted: `a` sorted by (hi, lo) pairs.
+
+    The 32-bit replacement for searching a packed u64 key `(hi << 32) | lo`
+    — same order, two u32 gathers per step instead of one split u64.
+    """
+    n = int(a_hi.shape[0])
+    pos = jnp.zeros(q_hi.shape, dtype=jnp.int32)
+    cur = n
+    while cur > 1:
+        half = cur >> 1
+        mid = pos + (half - 1)
+        go = _pred2(a_hi[mid], a_lo[mid], q_hi, q_lo, side)
+        pos = jnp.where(go, pos + half, pos)
+        cur -= half
+    return pos + _pred2(a_hi[pos], a_lo[pos], q_hi, q_lo, side).astype(jnp.int32)
+
+
+def sort_perm(cols) -> jnp.ndarray:
+    """`jnp.lexsort(cols)` with an i32 iota: last column is the primary key.
+
+    Returns the i32 permutation that stably sorts by (cols[-1], …, cols[0]).
+    Implemented as ONE stable lax.sort over all key columns plus an explicit
+    i32 iota payload — no 64-bit operand enters the sort.
+    """
+    cols = [
+        c.astype(jnp.int8) if c.dtype == jnp.bool_ else c
+        for c in (jnp.asarray(x) for x in cols)
+    ]
+    n = cols[0].shape[0]
+    iota = lax.iota(jnp.int32, int(n))
+    keys = list(reversed(cols))  # lax.sort: first operand is primary
+    out = lax.sort(tuple(keys) + (iota,), num_keys=len(keys), is_stable=True)
+    return out[-1]

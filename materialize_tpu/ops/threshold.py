@@ -13,7 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ..repr.batch import PAD_TIME, UpdateBatch
+from ..repr.batch import DIFF_DTYPE, PAD_TIME, UpdateBatch, to_device_time
 from ..repr.hashing import PAD_HASH
 from .consolidate import consolidate
 from .reduce import AccumState, _contributions, consolidate_accums, lookup_accums
@@ -21,7 +21,7 @@ from .reduce import AccumState, _contributions, consolidate_accums, lookup_accum
 
 def _multiplicity(mode: str, counts: jnp.ndarray) -> jnp.ndarray:
     if mode == "distinct":
-        return (counts > 0).astype(jnp.int64)
+        return (counts > 0).astype(DIFF_DTYPE)
     if mode == "threshold":
         return jnp.maximum(counts, 0)
     raise ValueError(mode)
@@ -44,7 +44,7 @@ def threshold_step(
     new_n = old_n + contrib.nrows
     out_d = _multiplicity(mode, new_n) - _multiplicity(mode, old_n)
     live = contrib.live & (out_d != 0)
-    t = jnp.asarray(time, dtype=jnp.uint64)
+    t = to_device_time(time)
     out = UpdateBatch(
         hashes=jnp.where(live, contrib.hashes, PAD_HASH),
         keys=(),

@@ -22,9 +22,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
+from ..repr.batch import (
+    DIFF_DTYPE,
+    PAD_TIME,
+    TIME_DTYPE,
+    UpdateBatch,
+    bucket_cap,
+    to_device_time,
+)
 from ..repr.hashing import PAD_HASH
 from .consolidate import advance_times, consolidate, row_equal_prev
+from .search import searchsorted, sort_perm
 
 
 @dataclass(frozen=True)
@@ -54,7 +62,7 @@ def distinct_keys(delta_keyed: UpdateBatch) -> UpdateBatch:
     """
     b = delta_keyed
     cols = [*(k for k in reversed(b.keys)), b.hashes]
-    order = jnp.lexsort(cols)
+    order = sort_perm(cols)
     h = b.hashes[order]
     ks = tuple(k[order] for k in b.keys)
     live_in = b.live[order]
@@ -72,33 +80,33 @@ def distinct_keys(delta_keyed: UpdateBatch) -> UpdateBatch:
     ) & live_in
     hashes = jnp.where(first_live, h, PAD_HASH)
     keys = tuple(jnp.where(first_live, k, jnp.zeros_like(k)) for k in ks)
-    perm = jnp.argsort(~first_live, stable=True)
+    perm = sort_perm((~first_live,))
     return UpdateBatch(
         hashes[perm],
         tuple(k[perm] for k in keys),
         (),
-        jnp.where(first_live, 0, PAD_TIME)[perm].astype(jnp.uint64),
-        jnp.where(first_live, 1, 0)[perm].astype(jnp.int64),
+        jnp.where(first_live, 0, PAD_TIME)[perm].astype(TIME_DTYPE),
+        jnp.where(first_live, 1, 0)[perm].astype(DIFF_DTYPE),
     )
 
 
 @jax.jit
 def _gather_total(probes: UpdateBatch, arr: UpdateBatch) -> jnp.ndarray:
-    lo = jnp.searchsorted(arr.hashes, probes.hashes, side="left")
-    hi = jnp.searchsorted(arr.hashes, probes.hashes, side="right")
+    lo = searchsorted(arr.hashes, probes.hashes, side="left")
+    hi = searchsorted(arr.hashes, probes.hashes, side="right")
     return jnp.sum(jnp.where(probes.live, hi - lo, 0))
 
 
 @partial(jax.jit, static_argnames=("out_cap",))
 def _gather_materialize(probes: UpdateBatch, arr: UpdateBatch, out_cap: int) -> UpdateBatch:
     """All arrangement rows whose key matches a probe key (collision-checked)."""
-    lo = jnp.searchsorted(arr.hashes, probes.hashes, side="left")
-    hi = jnp.searchsorted(arr.hashes, probes.hashes, side="right")
+    lo = searchsorted(arr.hashes, probes.hashes, side="left")
+    hi = searchsorted(arr.hashes, probes.hashes, side="right")
     counts = jnp.where(probes.live, hi - lo, 0)
     cum = jnp.cumsum(counts)
     total = cum[-1]
     j = jnp.arange(out_cap, dtype=cum.dtype)
-    pi = jnp.minimum(jnp.searchsorted(cum, j, side="right"), probes.cap - 1)
+    pi = jnp.minimum(searchsorted(cum, j, side="right"), probes.cap - 1)
     prev = jnp.where(pi > 0, cum[pi - 1], 0)
     ai = jnp.clip(lo[pi] + (j - prev), 0, arr.cap - 1)
     valid = j < total
@@ -162,7 +170,7 @@ def topk_select(
     for k in reversed(rows.keys):
         sort_cols.append(k)
     sort_cols.append(rows.hashes)
-    order = jnp.lexsort(sort_cols)
+    order = sort_perm(sort_cols)
     b = rows.permute(order)
     d = d[order]
 
@@ -175,9 +183,9 @@ def topk_select(
     lim = (1 << 62) if limit is None else limit
     hi_ = jnp.minimum(cum_before + d, offset + lim)
     lo_ = jnp.maximum(cum_before, offset)
-    out_d = jnp.maximum(hi_ - lo_, 0).astype(jnp.int64)
+    out_d = jnp.maximum(hi_ - lo_, 0).astype(DIFF_DTYPE)
     ok = (out_d > 0) & b.live
-    t = jnp.asarray(time, dtype=jnp.uint64)
+    t = to_device_time(time)
     # raw output: the full row lives in vals; keys were only for grouping
     return UpdateBatch(
         hashes=jnp.where(ok, b.hashes, PAD_HASH),

@@ -31,9 +31,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
+from ..repr.batch import DIFF_DTYPE, I64_DTYPE, PAD_TIME, UpdateBatch, bucket_cap, to_device_time
 from ..repr.hashing import PAD_HASH, value_view
 from .consolidate import row_equal_prev
+from .search import searchsorted, sort_perm
 from .topk import _ord_view, distinct_keys, gather_groups, negate
 
 
@@ -115,9 +116,9 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
     for k in reversed(rows.keys):
         sort_cols.append(value_view(k))
     sort_cols.append(rows.hashes)
-    order = jnp.lexsort(sort_cols)
+    order = sort_perm(sort_cols)
     b = rows.permute(order)
-    d = (jnp.maximum(b.diffs, 0) * b.live).astype(jnp.int64)
+    d = (jnp.maximum(b.diffs, 0) * b.live).astype(DIFF_DTYPE)
 
     idx = jnp.arange(n)
     part_start = ~row_equal_prev((b.hashes, *b.keys))
@@ -143,7 +144,7 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
 
     # -- expansion: one output instance per unit of multiplicity ------------
     j = jnp.arange(out_cap, dtype=cum_incl.dtype)
-    src = jnp.clip(jnp.searchsorted(cum_incl, j, side="right"), 0, n - 1)
+    src = jnp.clip(searchsorted(cum_incl, j, side="right"), 0, n - 1)
     valid = (j < total) & b.live[src]
     part_start_j = part_start_cnt[src]
     idx_in_part = j - part_start_j
@@ -158,7 +159,7 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
             if col.dtype == jnp.bool_:
                 col = col.astype(jnp.int8)
             null = _derived_null(col)
-            nn = jnp.where(null, 0, 1).astype(jnp.int64) * d
+            nn = jnp.where(null, 0, 1).astype(DIFF_DTYPE) * d
             nonnull = nn
             if spec.func == "count":
                 contrib = nn
@@ -166,7 +167,7 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
                 if jnp.issubdtype(col.dtype, jnp.floating):
                     contrib = jnp.where(null, 0.0, col) * d.astype(col.dtype)
                 else:
-                    contrib = jnp.where(null, 0, col).astype(jnp.int64) * d
+                    contrib = jnp.where(null, 0, col).astype(I64_DTYPE) * d
             else:  # min / max over the frame
                 take_max = spec.func == "max"
                 info_ext = (
@@ -206,9 +207,9 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
         elif spec.func == "rank":
             out = peer_start_cnt[src] - part_start_j + 1
         elif spec.func == "dense_rank":
-            out = (peer_id[src] - peer_id[part_first[src]] + 1).astype(jnp.int64)
+            out = (peer_id[src] - peer_id[part_first[src]] + 1).astype(I64_DTYPE)
         elif spec.func == "ntile":
-            nt = jnp.asarray(spec.offset, jnp.int64)
+            nt = jnp.asarray(spec.offset, I64_DTYPE)
             size = part_end_cnt[src] - part_start_j
             big = size - (size // nt) * nt  # parts with an extra row
             small_sz = size // nt
@@ -247,7 +248,7 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
             raise NotImplementedError(spec.func)
         func_cols.append(out.astype(np.dtype(spec.out_dtype)))
 
-    t_out = jnp.asarray(time, dtype=jnp.uint64)
+    t_out = to_device_time(time)
     vals = tuple(jnp.where(valid, v[src], 0) for v in b.vals) + tuple(
         jnp.where(valid, c, jnp.zeros_like(c)) for c in func_cols
     )
@@ -256,7 +257,7 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
         keys=(),
         vals=vals,
         times=jnp.where(valid, t_out, PAD_TIME),
-        diffs=jnp.where(valid, 1, 0).astype(jnp.int64),
+        diffs=jnp.where(valid, 1, 0).astype(DIFF_DTYPE),
     )
 
 

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.search import sort_perm
 from ..repr.batch import PAD_TIME, UpdateBatch
 from ..repr.hashing import PAD_HASH
 
@@ -35,10 +36,10 @@ def route_to_buckets(batch: UpdateBatch, n_dest: int, bucket_cap: int):
     live = batch.live
     dest = (batch.hashes % jnp.uint32(n_dest)).astype(jnp.int32)
     key = jnp.where(live, dest, n_dest)  # dead rows to a discard bucket
-    order = jnp.argsort(key, stable=True)
+    order = sort_perm((key,))  # stable, i32 iota — no 64-bit sort operand
     key_s = key[order]
     # rank within each destination run
-    idx = jnp.arange(cap)
+    idx = jnp.arange(cap, dtype=jnp.int32)
     run_start = jnp.concatenate(
         [jnp.ones((1,), dtype=jnp.bool_), key_s[1:] != key_s[:-1]]
     )

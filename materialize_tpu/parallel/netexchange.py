@@ -64,7 +64,8 @@ def route_dests(cols: dict, key_cols, n_workers: int) -> np.ndarray:
     if not picked:
         return np.zeros(nrows, dtype=np.int64)
     hashes = hash_columns_np(tuple(picked))
-    return (hashes.astype(np.uint64) % np.uint64(n_workers)).astype(np.int64)
+    # u32 hash mod n directly — same routing as the u64 cast, no widening
+    return (hashes % np.uint32(n_workers)).astype(np.int64)
 
 
 def partition_cols(cols: Optional[dict], key_cols, n_workers: int) -> list:

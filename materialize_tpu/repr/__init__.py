@@ -1,13 +1,30 @@
-from .batch import MIN_CAP, PAD_TIME, UpdateBatch, bucket_cap
+from .batch import (
+    DIFF_DTYPE,
+    I64_DTYPE,
+    MAX_DEVICE_TIME,
+    MIN_CAP,
+    PAD_TIME,
+    TIME_DTYPE,
+    UpdateBatch,
+    bucket_cap,
+    device_time_scalar,
+    to_device_time,
+)
 from .hashing import PAD_HASH, hash_columns, hash_columns_np, splitmix64
 from .timestamp import MAX_TS, Antichain
 from .types import ColType, ColumnDesc, RelationDesc, StringDictionary
 
 __all__ = [
+    "DIFF_DTYPE",
+    "I64_DTYPE",
+    "MAX_DEVICE_TIME",
     "MIN_CAP",
     "PAD_TIME",
+    "TIME_DTYPE",
     "UpdateBatch",
     "bucket_cap",
+    "device_time_scalar",
+    "to_device_time",
     "PAD_HASH",
     "hash_columns",
     "hash_columns_np",

@@ -10,6 +10,20 @@ with ``hash == PAD_HASH`` (sorts last), ``diff == 0`` and ``time == PAD_TIME``.
 Because every IVM operator is linear in ``diff``, diff==0 rows annihilate:
 padding flows through joins/reduces/consolidation without masks. Capacities
 are bucketed to powers of two so XLA recompiles O(log n) times, not O(n).
+
+**32-bit device times.** Logical time is u64 on the host (frontiers,
+antichains, `repr/timestamp.py` — the reference's `mz_repr::Timestamp`), but
+the DEVICE view of time is u32: the TPU VPU is a 32-bit machine, and XLA
+splits every u64 op into u32 pairs (X64SplitLow custom-calls, r2 profile), so
+u64 time columns doubled the cost of every sort tiebreak, every
+`max(t_l, t_r)` join rule, and the time column's HBM footprint. Times cross
+the host↔device boundary through `to_device_time`/`device_time_scalar`, which
+clamp real times into [0, MAX_DEVICE_TIME] — strictly below the u32 PAD_TIME
+sentinel, so a real max-u32 time can never impersonate padding (the truncated
+u64 all-ones sentinel WOULD equal 0xFFFFFFFF; the clamp is what keeps
+"padding sorts last" and "pad rows annihilate" true under 32-bit views).
+Engine times are tick counters, so the 2^32-2 ceiling is not a practical
+bound; host-side logical times beyond it saturate rather than wrap.
 """
 
 from __future__ import annotations
@@ -22,8 +36,53 @@ import numpy as np
 
 from .hashing import PAD_HASH, hash_columns
 
-PAD_TIME = np.uint64(0xFFFFFFFFFFFFFFFF)
+# ---------------------------------------------------------------------------
+# 64-bit boundary allowlist.
+#
+# Hot-path modules (ops/, arrangement/, parallel/exchange*) must not name
+# 64-bit dtypes directly — scripts/lint_32bit.py enforces it — so every
+# deliberate 64-bit device column is one of these aliases, decided HERE at
+# the representation boundary:
+#   TIME_DTYPE  u32 device time view (host logical time stays u64)
+#   DIFF_DTYPE  i64 multiplicities, the reference's `Diff`
+#               (src/repr/src/diff.rs:11); never a sort operand
+#   I64_DTYPE   i64 SQL bigint data / error codes / aggregate accumulators
+#               (value range is the point; also never a sort operand)
+TIME_DTYPE = jnp.uint32
+DIFF_DTYPE = jnp.int64
+I64_DTYPE = jnp.int64
+
+PAD_TIME = np.uint32(0xFFFFFFFF)
+# Largest representable real (non-padding) device time; boundary conversions
+# clamp here so no live row can collide with the PAD_TIME sentinel.
+MAX_DEVICE_TIME = int(PAD_TIME) - 1
+_PAD_TIME_U64 = np.uint64(0xFFFFFFFFFFFFFFFF)
 MIN_CAP = 8
+
+
+def device_time_scalar(t) -> np.uint32:
+    """Host boundary: one logical (u64-domain) time → its u32 device view.
+
+    Saturates at MAX_DEVICE_TIME (PAD_TIME is reserved for padding). Use for
+    tick/since/as_of/until scalars handed to device kernels.
+    """
+    return np.uint32(min(max(int(t), 0), MAX_DEVICE_TIME))
+
+
+def to_device_time(times) -> jnp.ndarray:
+    """Array boundary: logical times (u64/i64/int) → u32 device views.
+
+    The u64 all-ones padding sentinel maps to PAD_TIME; every other value
+    saturates into [0, MAX_DEVICE_TIME]. u32 inputs pass through untouched
+    (they are already device views).
+    """
+    t = jnp.asarray(times)
+    if t.dtype == jnp.uint32:
+        return t
+    t32 = jnp.clip(t, 0, MAX_DEVICE_TIME).astype(TIME_DTYPE)
+    if t.dtype == jnp.uint64:
+        t32 = jnp.where(t == _PAD_TIME_U64, PAD_TIME, t32)
+    return t32
 
 
 def bucket_cap(n: int, minimum: int = MIN_CAP) -> int:
@@ -40,7 +99,7 @@ class UpdateBatch:
     hashes: jnp.ndarray  # u32 [cap] — hash of key columns (PAD_HASH = padding)
     keys: tuple  # tuple of [cap] arrays (possibly empty tuple)
     vals: tuple  # tuple of [cap] arrays
-    times: jnp.ndarray  # u64 [cap]
+    times: jnp.ndarray  # u32 [cap] — device time view (PAD_TIME = padding)
     diffs: jnp.ndarray  # i64 [cap]
 
     # -- pytree plumbing ---------------------------------------------------
@@ -58,8 +117,8 @@ class UpdateBatch:
             hashes=jnp.full((cap,), PAD_HASH, dtype=jnp.uint32),
             keys=tuple(jnp.zeros((cap,), dtype=dt) for dt in key_dtypes),
             vals=tuple(jnp.zeros((cap,), dtype=dt) for dt in val_dtypes),
-            times=jnp.full((cap,), PAD_TIME, dtype=jnp.uint64),
-            diffs=jnp.zeros((cap,), dtype=jnp.int64),
+            times=jnp.full((cap,), PAD_TIME, dtype=TIME_DTYPE),
+            diffs=jnp.zeros((cap,), dtype=DIFF_DTYPE),
         )
 
     @staticmethod
@@ -67,8 +126,8 @@ class UpdateBatch:
         """Build a padded device batch from host (or device) columns."""
         key_cols = tuple(jnp.asarray(c) for c in key_cols)
         val_cols = tuple(jnp.asarray(c) for c in val_cols)
-        times = jnp.asarray(times, dtype=jnp.uint64)
-        diffs = jnp.asarray(diffs, dtype=jnp.int64)
+        times = to_device_time(times)
+        diffs = jnp.asarray(diffs, dtype=DIFF_DTYPE)
         n = int(times.shape[0])
         if cap is None:
             cap = bucket_cap(n)

@@ -8,13 +8,24 @@ Plays the role of the reference's key-hash exchange pacts
 
 u32, not u64, on purpose: the TPU VPU is a 32-bit machine — XLA splits every
 u64 op into u32 pairs (X64SplitLow custom-calls, r2 profile), so u64 hashes
-double the cost of the three hottest kernels (sort keys, searchsorted probes,
-exchange routing) and double the hash column's HBM footprint. Collisions rise
-(~n²/2³³ colliding pairs) but every kernel already verifies true key equality
-on gather, consolidation confirms runs by full-row compare, and the reduce
-lookup's bucket-scan overflow is detected and surfaced as an error — so a
-collision costs capacity, never correctness. Mixing still runs through
-splitmix64 (u64) per column for quality; only the final fold is 32-bit.
+double the cost of the three hottest kernels (sort keys, binary-search
+probes, exchange routing) and double the hash column's HBM footprint.
+Collisions rise (~n²/2³³ colliding pairs) but every kernel already verifies
+true key equality on gather, consolidation confirms runs by full-row
+compare, and the reduce lookup's bucket-scan overflow is detected and
+surfaced as an error — so a collision costs capacity, never correctness.
+Mixing still runs through splitmix64 (u64) per column for quality; only the
+final fold is 32-bit. The u64 mixing here is elementwise and tiny next to
+the sort/probe kernels — it is the sanctioned 64-bit island of the
+representation layer (see the boundary allowlist in repr/batch.py), kept
+EXACTLY as-is so hash values (and therefore arrangement layouts, exchange
+routing, and canonical row order) are bit-identical across the 32-bit-native
+tick pipeline change.
+
+Ordering keys derived from these hashes are (hi, lo) u32 PAIRS end-to-end
+(ops/consolidate.pack_sort_key, ops/reduce._accum_pack): sorts take them as
+two native u32 operands and probes compare them with two-key branchless
+binary search (ops/search.py) — no packed u64 ever materializes on device.
 """
 
 from __future__ import annotations

@@ -1,0 +1,88 @@
+"""HLO-scan guard: the compiled Q3 tick is 32-bit native and loop-free.
+
+The r2 TPU profile showed XLA splitting every u64 op into u32 pairs
+(X64SplitLow) and `jnp.searchsorted` lowering to sequential while loops —
+the two taxes the 32-bit-native pipeline removed. This test compiles the
+fused Q3 tick at tiny capacities (same program structure as the benchmark
+tick) and scans the optimized HLO text:
+
+  1. no sort carries a 64-bit operand (sort keys are u32 pairs + u32 time
+     views; diffs/accums are gathered by the permutation, never sorted), and
+  2. no `while` loop anywhere in the tick (probe kernels are branchless
+     fixed-depth binary searches; the collision scan is unrolled).
+
+If either assertion fires, a 64-bit dtype or a data-dependent loop crept
+back into the hot path — the exact regressions this PR removed.
+"""
+
+import re
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.smoke
+
+
+def _tiny_tick_hlo() -> str:
+    import jax
+
+    from materialize_tpu.models.fused_q3 import Q3Caps, Q3State, q3_tick_single
+    from materialize_tpu.repr import UpdateBatch, device_time_scalar
+
+    caps = Q3Caps(
+        cust=1 << 6,
+        orders=1 << 7,
+        lineitem=1 << 8,
+        delta=1 << 5,
+        bucket=1 << 5,
+        join_out=1 << 7,
+        groups=1 << 7,
+        val_dtype="int32",
+    )
+    state = Q3State.empty(caps)
+    V = np.dtype(np.int32)
+    d_cust = UpdateBatch.empty(caps.delta, (), (V,) * 3)
+    d_ord = UpdateBatch.empty(caps.delta, (), (V,) * 4)
+    d_li = UpdateBatch.empty(caps.delta, (), (V,) * 6)
+    step = jax.jit(q3_tick_single(caps))
+    lowered = step.lower(state, d_cust, d_ord, d_li, device_time_scalar(2))
+    return lowered.compile().as_text()
+
+
+@pytest.fixture(scope="module")
+def q3_hlo():
+    return _tiny_tick_hlo()
+
+
+def test_no_64bit_sort_operands(q3_hlo):
+    offenders = []
+    for line in q3_hlo.splitlines():
+        if re.search(r"=\s*\(?[a-z0-9\[\]{}, ]*\)?\s*sort\(", line) or " sort(" in line:
+            if re.search(r"\b[suf]64\[", line):
+                offenders.append(line.strip()[:200])
+    assert not offenders, (
+        "64-bit sort operands crept back into the compiled tick:\n"
+        + "\n".join(offenders)
+    )
+    # sanity: the tick does contain sorts (otherwise the scan is vacuous)
+    assert any(" sort(" in line for line in q3_hlo.splitlines())
+
+
+def test_no_while_loops_in_probe_kernels(q3_hlo):
+    # XLA:CPU lowers scatter/scatter-add (permutation inversion, segment
+    # sums) to sequential loops — those are native vector ops on the TPU and
+    # are not the regression this guards. Any OTHER while is: the
+    # searchsorted-style probe loops the branchless binary search removed.
+    offenders = []
+    for line in q3_hlo.splitlines():
+        if not re.search(r"\bwhile\(", line):
+            continue
+        m = re.search(r'op_name="([^"]*)"', line)
+        kind = (m.group(1) if m else "?").rsplit("/", 1)[-1]
+        if kind not in ("scatter", "scatter-add", "scatter-update"):
+            offenders.append(f"[{kind}] {line.strip()[:180]}")
+    assert not offenders, (
+        "data-dependent while loops crept back into the compiled tick "
+        "(searchsorted-style probes must stay branchless):\n"
+        + "\n".join(offenders)
+    )

@@ -1,0 +1,48 @@
+"""Tier-1 guard: the device hot path stays 32-bit native.
+
+Wraps scripts/lint_32bit.py — no `jnp.int64`/`jnp.uint64`/`jnp.float64` (in
+any array-creating spelling) inside ops/, arrangement/, or the exchange
+partitioners. Deliberate 64-bit device columns go through the boundary
+aliases in repr/batch.py (TIME_DTYPE / DIFF_DTYPE / I64_DTYPE), which keeps
+every 64-bit decision greppable in one place.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_hot_path_is_32bit_native():
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import lint_32bit
+    finally:
+        sys.path.pop(0)
+    violations = lint_32bit.lint()
+    assert not violations, "\n".join(violations)
+
+
+def test_lint_script_runs_standalone():
+    r = subprocess.run(
+        [sys.executable, str(REPO / "scripts" / "lint_32bit.py")],
+        capture_output=True,
+        text=True,
+        timeout=60,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_lint_catches_a_violation(tmp_path):
+    sys.path.insert(0, str(REPO / "scripts"))
+    try:
+        import lint_32bit
+    finally:
+        sys.path.pop(0)
+    bad = tmp_path / "bad.py"
+    bad.write_text("x = jnp.zeros((4,), dtype=jnp.uint64)\n")
+    assert lint_32bit.lint([bad])
+    ok = tmp_path / "ok.py"
+    ok.write_text("x = jnp.zeros((4,), dtype=TIME_DTYPE)  # jnp.uint64 in comment\n")
+    assert not lint_32bit.lint([ok])

@@ -1,0 +1,109 @@
+"""PAD_HASH / PAD_TIME sentinel semantics under the 32-bit device views.
+
+The u64 all-ones PAD_TIME truncates to 0xFFFFFFFF in u32 — the same bit
+pattern as a real max u32 time — so the boundary conversions must keep real
+times strictly below the sentinel (MAX_DEVICE_TIME = 0xFFFFFFFE). These are
+the regression tests that padding still sorts last and pad rows still
+annihilate at the extremes of both sentinels.
+"""
+
+import numpy as np
+
+from materialize_tpu.ops.consolidate import consolidate, merge_consolidate
+from materialize_tpu.repr import (
+    MAX_DEVICE_TIME,
+    PAD_HASH,
+    PAD_TIME,
+    UpdateBatch,
+    device_time_scalar,
+    to_device_time,
+)
+
+
+def test_boundary_clamps_below_pad_time():
+    # a logical time at/above 2^32-1 must saturate BELOW the padding sentinel
+    times = np.array([0, 7, MAX_DEVICE_TIME, 0xFFFFFFFF, (1 << 40)], dtype=np.uint64)
+    got = np.asarray(to_device_time(times))
+    assert got.dtype == np.uint32
+    assert list(got[:3]) == [0, 7, MAX_DEVICE_TIME]
+    # ...except the u64 all-ones padding sentinel itself, which maps to pad
+    pad64 = np.array([0xFFFFFFFFFFFFFFFF], dtype=np.uint64)
+    assert np.asarray(to_device_time(pad64))[0] == PAD_TIME
+    assert got[3] == MAX_DEVICE_TIME and got[4] == MAX_DEVICE_TIME
+    assert int(device_time_scalar((1 << 50))) == MAX_DEVICE_TIME
+    assert int(device_time_scalar(3)) == 3
+
+
+def _extreme_batch():
+    """Live rows at the sentinel edges plus interleaved padding."""
+    vals = (np.array([1, 1, 2, 3], dtype=np.int64),)
+    times = np.array([MAX_DEVICE_TIME, MAX_DEVICE_TIME, 0, MAX_DEVICE_TIME],
+                     dtype=np.uint64)
+    diffs = np.array([1, -1, 1, 1], dtype=np.int64)
+    return UpdateBatch.build((), vals, times, diffs, cap=8)
+
+
+def test_padding_sorts_last_at_max_time():
+    b = consolidate(_extreme_batch())
+    live = np.asarray(b.live)
+    hashes = np.asarray(b.hashes)
+    times = np.asarray(b.times)
+    # all live rows precede all padding rows
+    n_live = int(live.sum())
+    assert n_live == 2  # the (1, t_max) pair annihilated
+    assert live[:n_live].all() and not live[n_live:].any()
+    # padding keeps both sentinels; no live row carries either sentinel
+    assert (hashes[n_live:] == PAD_HASH).all()
+    assert (times[n_live:] == PAD_TIME).all()
+    assert (hashes[:n_live] != PAD_HASH).all()
+    assert (times[:n_live] != PAD_TIME).all()
+
+
+def test_pad_rows_annihilate_through_merge():
+    # merging two batches that are mostly padding must not resurrect pads or
+    # let a real max-time row merge with them
+    a = consolidate(_extreme_batch())
+    b = consolidate(
+        UpdateBatch.build(
+            (),
+            (np.array([3], dtype=np.int64),),
+            np.array([MAX_DEVICE_TIME], dtype=np.uint64),
+            np.array([-1], dtype=np.int64),
+            cap=8,
+        )
+    )
+    m = merge_consolidate(a, b)
+    rows = m.to_rows()
+    assert rows == [((2,), 0, 1)]
+    # every non-live slot is full padding
+    live = np.asarray(m.live)
+    assert (np.asarray(m.hashes)[~live] == PAD_HASH).all()
+    assert (np.asarray(m.times)[~live] == PAD_TIME).all()
+    assert (np.asarray(m.diffs)[~live] == 0).all()
+
+
+def test_live_hash_never_equals_pad_hash():
+    from materialize_tpu.repr import hash_columns
+
+    # scan a range of values for a hash that would land on PAD_HASH: the
+    # clamp in hash_columns must keep every live hash strictly below it
+    cols = (np.arange(1 << 14, dtype=np.int64),)
+    h = np.asarray(hash_columns(tuple(np.asarray(c) for c in cols)))
+    assert (h != np.uint32(PAD_HASH)).all()
+
+
+def test_until_and_since_clamp():
+    from materialize_tpu.dataflow.runtime import _truncate_until
+    from materialize_tpu.ops.consolidate import advance_times
+    from materialize_tpu.repr import MAX_TS
+
+    b = _extreme_batch()
+    # an unbounded `until` (u64 max) keeps every live row
+    kept = _truncate_until(b, MAX_TS)
+    assert int(np.asarray(kept.live).sum()) == int(np.asarray(b.live).sum())
+    # a saturating `since` advances live times to MAX_DEVICE_TIME, never PAD
+    adv = advance_times(b, device_time_scalar(MAX_TS))
+    times = np.asarray(adv.times)
+    live = np.asarray(b.live)
+    assert (times[live] == MAX_DEVICE_TIME).all()
+    assert (times[~live] == PAD_TIME).all()

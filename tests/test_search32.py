@@ -1,0 +1,83 @@
+"""Branchless fixed-depth binary search (ops/search.py) vs NumPy oracles.
+
+The probe kernels replaced `jnp.searchsorted` (a vmapped while loop) with
+unrolled branchless binary search; these tests pin the exact searchsorted
+contract — including duplicates, all-smaller/all-larger queries, and the
+two-key (hi, lo) pair order — against np.searchsorted on the packed u64.
+"""
+
+import numpy as np
+import pytest
+
+from materialize_tpu.ops.search import searchsorted, searchsorted2, sort_perm
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 8, 17, 64, 1000])
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_searchsorted_matches_numpy(rng, n, side):
+    a = np.sort(rng.integers(0, max(n // 2, 2), n).astype(np.uint32))
+    q = rng.integers(-1, max(n // 2, 2) + 1, 257).astype(np.int64)
+    q32 = q.clip(0, None).astype(np.uint32)
+    got = np.asarray(searchsorted(a, q32, side=side))
+    want = np.searchsorted(a, q32, side=side)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == np.int32
+
+
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_searchsorted_extremes(side):
+    a = np.array([5, 5, 5, 5], dtype=np.uint32)
+    q = np.array([0, 5, 9, 0xFFFFFFFF], dtype=np.uint32)
+    got = np.asarray(searchsorted(a, q, side=side))
+    np.testing.assert_array_equal(got, np.searchsorted(a, q, side=side))
+
+
+@pytest.mark.parametrize("n", [1, 2, 8, 33, 256])
+@pytest.mark.parametrize("side", ["left", "right"])
+def test_searchsorted2_matches_packed_u64(rng, n, side):
+    hi = rng.integers(0, 4, n).astype(np.uint32)
+    lo = rng.integers(0, 4, n).astype(np.uint32)
+    packed = (hi.astype(np.uint64) << np.uint64(32)) | lo.astype(np.uint64)
+    order = np.argsort(packed, kind="stable")
+    hi, lo, packed = hi[order], lo[order], packed[order]
+    qh = rng.integers(0, 5, 301).astype(np.uint32)
+    ql = rng.integers(0, 5, 301).astype(np.uint32)
+    qp = (qh.astype(np.uint64) << np.uint64(32)) | ql.astype(np.uint64)
+    got = np.asarray(searchsorted2(hi, lo, qh, ql, side=side))
+    np.testing.assert_array_equal(got, np.searchsorted(packed, qp, side=side))
+
+
+def test_searchsorted2_sentinel_rows_sort_last(rng):
+    # PAD rows carry the maximal hi key: probes below it must never land past
+    # a pad boundary on the left side
+    hi = np.array([1, 2, 0xFFFFFFFF, 0xFFFFFFFF], dtype=np.uint32)
+    lo = np.array([9, 0, 0, 5], dtype=np.uint32)
+    got = np.asarray(
+        searchsorted2(
+            hi,
+            lo,
+            np.array([0xFFFFFFFE], dtype=np.uint32),
+            np.array([0xFFFFFFFF], dtype=np.uint32),
+            side="right",
+        )
+    )
+    np.testing.assert_array_equal(got, [2])
+
+
+def test_sort_perm_matches_lexsort(rng):
+    n = 500
+    cols = (
+        rng.integers(0, 5, n).astype(np.uint32),
+        rng.integers(0, 5, n).astype(np.int32),
+        rng.integers(0, 5, n).astype(np.uint32),
+    )
+    got = np.asarray(sort_perm(cols))
+    want = np.lexsort(cols)
+    np.testing.assert_array_equal(got, want)
+    assert got.dtype == np.int32
+
+
+def test_sort_perm_stable_bool():
+    keys = np.array([True, False, True, False, False], dtype=np.bool_)
+    got = np.asarray(sort_perm((keys,)))
+    np.testing.assert_array_equal(got, np.lexsort((keys,)))
