@@ -11,9 +11,12 @@ feed storage collections (the persist-sink shape, sink/materialized_view.rs).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
+from time import monotonic as _monotonic
 from typing import Any, Optional
 
 import numpy as np
+
+from ..errors import QueryCanceled
 
 from ..arrangement.spine import Arrangement
 from ..dataflow import Dataflow
@@ -109,8 +112,22 @@ class Coordinator:
         self.dataflows: list = []
         self.planner = Planner(self.catalog)
         from .dyncfg import default_configs
+        from .overload import AdmissionGate, OverloadStats
 
         self.configs = default_configs()
+        # overload protection: every shed/cancel/yield decision is counted
+        # (mz_overload_counters); the gates bound the waiting line in front
+        # of the single-threaded command loop (adapter/overload.py)
+        self.overload = OverloadStats()
+        self.admission = AdmissionGate(
+            "statement", lambda: self.configs.get("coord_queue_depth"), self.overload
+        )
+        self.peek_gate = AdmissionGate(
+            "peek", lambda: self.configs.get("peek_queue_depth"), self.overload
+        )
+        # pgwire cancellation registry: backend pid -> (secret key, session);
+        # a CancelRequest must present the exact secret or it is a no-op
+        self.cancel_keys: dict[int, tuple] = {}
         self.blob = blob
         self.consensus = consensus
         if data_dir is not None:
@@ -169,17 +186,66 @@ class Coordinator:
 
         self._session = session  # per-statement; coordinator is single-threaded
         self.planner.set_params(params)
+        # NOTE: session.cancelled is deliberately NOT cleared here. A cancel
+        # targets the in-flight QUERY MESSAGE, which may be a multi-statement
+        # script — clearing per statement would drop a cancel at the next
+        # statement boundary. The protocol layer (pgwire) clears the event
+        # once per incoming query message instead.
+        timeout_ms = int(self._cfg().get("statement_timeout"))
+        # The timer starts at query RECEIPT when the protocol layer stamped
+        # one (pg semantics): time spent waiting in the admission queue and
+        # on the coordinator lock counts against the budget, so a statement
+        # that queued past its deadline cancels at the entry checkpoint
+        # instead of running arbitrarily late. Consumed once — later
+        # statements of the same script start their own windows.
+        t0 = _monotonic()
+        if session is not None:
+            arrival = getattr(session, "arrival", None)
+            if arrival is not None:
+                t0 = arrival
+                session.arrival = None
+        self._deadline = t0 + timeout_ms / 1000.0 if timeout_ms > 0 else None
         try:
             with TRACER.span(f"execute:{type(stmt).__name__}"):
                 return self._execute_stmt_inner(stmt)
+        except Exception as e:
+            from ..errors import ResultSizeExceeded
+
+            if isinstance(e, ResultSizeExceeded):
+                self.overload.bump("result_size_rejections")
+            raise
         finally:
+            self._deadline = None
             self.planner.set_params(None)
+
+    def check_cancellation(self) -> None:
+        """Cooperative checkpoint (57014): raises QueryCanceled once the
+        statement's deadline passed or its session was canceled. Installed as
+        `Dataflow.cancel_check` on ephemeral peek dataflows and called at
+        coordinator read-path boundaries; NEVER consulted past a durable
+        commit point, so a timeout can't tear a write."""
+        s = getattr(self, "_session", None)
+        if (
+            s is not None
+            and getattr(s, "cancelled", None) is not None
+            and s.cancelled.is_set()
+        ):
+            self.overload.bump("cancels_honored")
+            raise QueryCanceled("canceling statement due to user request")
+        dl = getattr(self, "_deadline", None)
+        if dl is not None and _monotonic() >= dl:
+            self.overload.bump("statement_timeouts")
+            raise QueryCanceled("canceling statement due to statement timeout")
 
     def _cfg(self):
         """Effective configs: session overlay when a session is active."""
         return self._session if getattr(self, "_session", None) is not None else self.configs
 
     def _execute_stmt_inner(self, stmt) -> ExecResult:
+        # entry checkpoint: a statement admitted after its deadline (it sat
+        # in the admission queue too long) cancels BEFORE doing any work —
+        # nothing durable has happened yet for any statement kind
+        self.check_cancellation()
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.CreateSource):
@@ -221,6 +287,18 @@ class Coordinator:
 
                 TRACER.set_filter(self._cfg().get("log_filter"))
             return ExecResult("status", status="SET")
+        if isinstance(stmt, ast.ResetVariable):
+            if stmt.name not in self.configs.names():
+                raise PlanError(
+                    f"unknown configuration parameter: {stmt.name}"
+                )
+            target = (
+                self._session
+                if getattr(self, "_session", None) is not None
+                else self.configs
+            )
+            target.reset(stmt.name)
+            return ExecResult("status", status="RESET")
         if isinstance(stmt, ast.Update):
             return self._update(stmt)
         if isinstance(stmt, ast.Copy):
@@ -1249,22 +1327,40 @@ class Coordinator:
                     pass
 
     def advance(self, n_rows: int = 100) -> int:
-        """Pull one batch from every generator source and commit it."""
+        """Pull one batch from every generator source and commit it.
+
+        Ingest is byte-budgeted (`source_ingest_budget_bytes`): each source
+        gets a bounded grant per tick and YIELDS its remainder to later ticks
+        instead of growing this tick without bound — the backpressure half of
+        overload protection (storage/backpressure.py). Yields are counted in
+        mz_overload_counters.ingest_yields."""
+        from ..storage.backpressure import IngestBudget, batch_bytes_estimate
+
         ts = self.oracle.write_ts()
         writes: dict[str, UpdateBatch] = {}
+        budget = IngestBudget(int(self.configs.get("source_ingest_budget_bytes")))
         for gen, gids in self.generators:
+            # a spent budget still grants one record per source (the
+            # IngestBudget liveness floor): sources shrink, never starve
             if isinstance(gen, AuctionGenerator):
-                batches = gen.next_tick(ts, n_rows)
+                batches = gen.next_tick(ts, budget.grant_rows(gen.ROW_BYTES, n_rows))
             elif isinstance(gen, CounterGenerator):
+                budget.grant_rows(gen.ROW_BYTES, 1)
                 batches = gen.next_tick(ts, 1)
             elif hasattr(gen, "upsert"):  # KeyValueGenerator
-                batches = gen.next_tick(ts, n_rows)
+                batches = gen.next_tick(ts, budget.grant_rows(gen.ROW_BYTES, n_rows))
             else:
+                # TPC-H refresh sizes itself; charge the actual batches so
+                # later sources in the same tick see the spend
                 batches = gen.refresh(ts)
+                for b in batches.values():
+                    budget.charge(batch_bytes_estimate(b))
             for t, b in batches.items():
                 if t in gids:
                     writes[gids[t]] = b
-        remap, committed = self._poll_file_sources(writes, ts, n_rows)
+        remap, committed = self._poll_file_sources(writes, ts, n_rows, budget)
+        if budget.yields:
+            self.overload.bump("ingest_yields", budget.yields)
         # remap alone (all polled lines blank/malformed) still commits: the
         # binding must advance src.offset or the same bytes are re-read and
         # re-counted in decode_errors every tick (advisor r2, low)
@@ -1384,11 +1480,15 @@ class Coordinator:
         raise RuntimeError(f"no replica could serve peek {index_id}: {last}")
 
     # -- external file sources -------------------------------------------------
-    def _poll_file_sources(self, writes: dict, ts: int, max_records: int):
+    def _poll_file_sources(self, writes: dict, ts: int, max_records: int,
+                           budget=None):
         """Ingest new records from every file source into `writes`; returns
         the remap-shard bindings to commit atomically with the data
         (reclocking: offset ranges bind to engine timestamps exactly once,
-        reference src/storage/src/source/reclock.rs:277)."""
+        reference src/storage/src/source/reclock.rs:277). `budget` is the
+        tick's shared IngestBudget: polls are byte-capped and unread bytes
+        wait for a later tick (the remap binding only ever covers what was
+        actually consumed, so exactly-once is unaffected)."""
         remap: dict[str, dict] = {}
         committed: list = []  # (src, new_offset, (upsert_state, backup)|None)
         for entry in getattr(self, "file_sources", []):
@@ -1403,10 +1503,30 @@ class Coordinator:
             )
             if item is None:
                 continue  # dropped concurrently
+            max_bytes = budget.remaining if budget is not None else None
+            if max_bytes is not None and max_bytes <= 0:
+                # liveness floor: a spent budget still reads ONE record (the
+                # capped poll extends to its line's end), so an earlier
+                # hungry source can never starve this one tick after tick
+                budget.note_yield()
+                max_bytes = 1
             try:
-                records, new_offset = src.poll(max_records)
+                records, new_offset = src.poll(max_records, max_bytes=max_bytes)
             except OSError:
                 continue  # transient file trouble; retry next tick
+            if budget is not None:
+                budget.charge(new_offset - src.offset)
+                if max_bytes is not None:
+                    import os as _os
+
+                    try:
+                        size = _os.path.getsize(src.spec.path)
+                    except OSError:
+                        size = new_offset
+                    # a binding cap (smaller than what was pending) with
+                    # bytes left over = this source yielded to later ticks
+                    if size - src.offset > max_bytes and size > new_offset:
+                        budget.note_yield()
             if new_offset == src.offset:
                 continue
             backup = None
@@ -1516,10 +1636,16 @@ class Coordinator:
         return int(v)
 
     # -- reads -----------------------------------------------------------------
+    def _result_budget(self) -> int | None:
+        """max_result_size in bytes, or None when unlimited (0)."""
+        b = int(self._cfg().get("max_result_size"))
+        return b if b > 0 else None
+
     def _select(self, query: ast.Query) -> ExecResult:
         import time as _time
 
         t0 = _time.perf_counter_ns()
+        self.check_cancellation()
         pq = self.planner.plan_query(query)
         rel = optimize(pq.mir, self._cfg())
         as_of = self.oracle.read_ts()
@@ -1534,9 +1660,12 @@ class Coordinator:
                 until=as_of + 1,
             )
             df = Dataflow(desc)
+            # the ephemeral dataflow is cancel-safe: no shared state to tear,
+            # so the tick loop checks the deadline between every dispatch
+            df.cancel_check = self.check_cancellation
             snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
             df.step(as_of, snaps)
-            rows = df.peek("idx_peek")
+            rows = df.peek("idx_peek", byte_budget=self._result_budget())
         rows = self._finish(rows, pq)
         self._record_peek(_time.perf_counter_ns() - t0)
         return ExecResult("rows", rows=rows, columns=tuple(c.name for c in pq.scope.cols))
@@ -1579,7 +1708,9 @@ class Coordinator:
                     b.project(node.outputs)
             mfp = b.finish()
             out = []
-            for row in inner_rows:
+            for _i, row in enumerate(inner_rows):
+                if (_i & 1023) == 0:
+                    self.check_cancellation()
                 cols = list(row)
                 err = None
                 for m in mfp.map_exprs:
@@ -1603,9 +1734,10 @@ class Coordinator:
                 out.append(tuple(cols[i] for i in mfp.projection))
             return sorted(out, key=_null_safe_row_key)
         if isinstance(rel, mir.MirGet):
+            budget = self._result_budget()
             for mv_gid, df, _src in self.dataflows:
                 if mv_gid == rel.id:
-                    rows = df.peek(f"idx_{mv_gid}", at=as_of)
+                    rows = df.peek(f"idx_{mv_gid}", at=as_of, byte_budget=budget)
                     return self._sentinels_to_none(rows, rel.id)
             st = self.storage.get(rel.id)
             if st is not None:
@@ -1614,12 +1746,14 @@ class Coordinator:
                     triples = st.arr.rows_host(as_of)
                 else:  # introspection collections build a fresh batch
                     triples = st.snapshot(as_of).to_rows()
-                for data, _t, d in triples:
+                for _i, (data, _t, d) in enumerate(triples):
+                    if (_i & 4095) == 0:
+                        self.check_cancellation()
                     out[data] = out.get(data, 0) + d
                 from ..dataflow.runtime import materialize_counts
 
                 return self._sentinels_to_none(
-                    materialize_counts(out, rel.id), rel.id
+                    materialize_counts(out, rel.id, byte_budget=budget), rel.id
                 )
         return None
 
@@ -1662,8 +1796,28 @@ class Coordinator:
         return out
 
     def _finish(self, rows: list, pq: PlannedQuery) -> list:
+        from ..dataflow.runtime import row_bytes_estimate
+        from ..errors import ResultSizeExceeded
+
         f = pq.finishing
-        decoded = [self._decode_row(r, pq) for r in rows]
+        # max_result_size bounds the MATERIALIZED working set (pre-LIMIT:
+        # ORDER BY needs every row in memory before the limit can apply), so
+        # the decode loop stops at the budget instead of building the rest
+        budget = self._result_budget()
+        decoded = []
+        spent = 0
+        for i, r in enumerate(rows):
+            if (i & 511) == 0:
+                self.check_cancellation()
+            d = self._decode_row(r, pq)
+            if budget is not None:
+                spent += row_bytes_estimate(d)
+                if spent > budget:
+                    raise ResultSizeExceeded(
+                        f"result exceeds max_result_size ({budget} bytes); "
+                        f"aborted after {len(decoded)} rows"
+                    )
+            decoded.append(d)
         if f.order_by:
             nulls = f.nulls_last or tuple(not d for _c, d in f.order_by)
             for (col, desc_), nl in reversed(list(zip(f.order_by, nulls))):

@@ -132,10 +132,71 @@ MESH_EXCHANGE_TIMEOUT = Config(
     "instead of hanging the shard's command loop",
 )
 
+# -- overload protection (the serving path's graceful-degradation knobs) -----
+STATEMENT_TIMEOUT = Config(
+    "statement_timeout",
+    0,
+    "milliseconds a statement may run before cooperative cancellation fires "
+    "with SQLSTATE 57014 (0 = off; checked between operator dispatches in "
+    "the tick loop and at coordinator checkpoints — the pg statement_timeout "
+    "session var)",
+)
+IDLE_SESSION_TIMEOUT = Config(
+    "idle_in_transaction_session_timeout",
+    0,
+    "milliseconds a pgwire connection may sit idle between statements before "
+    "it is terminated with SQLSTATE 57P05 (0 = off; every statement here is "
+    "an implicit single-statement transaction, so this acts as an idle-"
+    "session timeout)",
+)
+MAX_RESULT_SIZE = Config(
+    "max_result_size",
+    128 << 20,
+    "bytes a single result set may occupy before the peek aborts with "
+    "SQLSTATE 53400 — enforced DURING materialization (count expansion and "
+    "row decode stop at the budget), so an oversized result is rejected "
+    "without ever being fully built (0 = off)",
+)
+MAX_CONNECTIONS = Config(
+    "max_connections",
+    256,
+    "pgwire connections accepted concurrently; the overflow connection gets "
+    "an immediate, retryable 53300 ErrorResponse and is closed (0 = off)",
+)
+COORD_QUEUE_DEPTH = Config(
+    "coord_queue_depth",
+    64,
+    "statements allowed in the coordinator's waiting line (queued + "
+    "executing) across all frontends; the overflow statement is shed with a "
+    "retryable 53300 instead of queuing unboundedly (0 = off)",
+)
+PEEK_QUEUE_DEPTH = Config(
+    "peek_queue_depth",
+    32,
+    "SELECT/SHOW/EXPLAIN statements allowed in the peek admission line "
+    "(tighter than coord_queue_depth so a read swarm can't starve writes); "
+    "overflow sheds with 53300 (0 = off)",
+)
+SOURCE_INGEST_BUDGET = Config(
+    "source_ingest_budget_bytes",
+    8 << 20,
+    "byte budget one `advance()` tick may ingest across all sources "
+    "(generators + file tails); a source with more data YIELDS the remainder "
+    "to later ticks instead of growing the tick without bound — counted in "
+    "mz_overload_counters.ingest_yields (0 = off)",
+)
+
 ALL_CONFIGS = [
     MV_SINK_SELF_CORRECT,
     CTP_MAX_FRAME_BYTES,
     MESH_EXCHANGE_TIMEOUT,
+    STATEMENT_TIMEOUT,
+    IDLE_SESSION_TIMEOUT,
+    MAX_RESULT_SIZE,
+    MAX_CONNECTIONS,
+    COORD_QUEUE_DEPTH,
+    PEEK_QUEUE_DEPTH,
+    SOURCE_INGEST_BUDGET,
     ENABLE_DELTA_JOIN,
     DELTA_JOIN_MAX_INPUTS,
     LSM_MERGE_RATIO,
@@ -155,11 +216,23 @@ def default_configs() -> ConfigSet:
 class SessionConfigs:
     """Per-session overlay over the system ConfigSet (the reference's session
     vars vs system vars split, src/sql/src/session/vars): SET writes here,
-    ALTER SYSTEM writes the underlying set; reads check the overlay first."""
+    ALTER SYSTEM writes the underlying set; reads check the overlay first.
+
+    Also the session's cancellation token: `cancelled` is set by a pgwire
+    CancelRequest bearing the connection's secret key and checked at the
+    coordinator/tick-loop checkpoints — setting an Event is lock-free, so a
+    cancel never queues behind the very statement it is trying to stop."""
 
     def __init__(self, system: ConfigSet):
+        import threading
+
         self.system = system
         self.overrides: dict = {}
+        self.cancelled = threading.Event()
+        # query-receipt timestamp stamped by the protocol layer: the
+        # statement_timeout window opens HERE, so admission-queue wait
+        # counts against the budget (consumed by Coordinator.execute_stmt)
+        self.arrival: float | None = None
 
     def get(self, name: str):
         if name in self.overrides:

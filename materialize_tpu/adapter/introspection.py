@@ -56,6 +56,10 @@ INTROSPECTION_TABLES = {
         ("bucket_ns_le", ColType.INT64),
         ("count", ColType.INT64),
     ),
+    "mz_overload_counters": _desc(
+        ("name", ColType.STRING),
+        ("value", ColType.INT64),
+    ),
     "mz_arrangement_sizes": _desc(
         ("dataflow", ColType.STRING),
         ("operator_id", ColType.INT64),
@@ -120,6 +124,13 @@ def introspection_rows(coord, name: str) -> list[tuple]:
         ]
     if name == "mz_peek_durations":
         return sorted(getattr(coord, "peek_histogram", {}).items())
+    if name == "mz_overload_counters":
+        # cumulative shed/cancel/yield counters plus live queue-depth gauges:
+        # degradation decisions are queryable, not just logged
+        counts = dict(coord.overload.snapshot())
+        counts["statement_queue_depth"] = coord.admission.depth
+        counts["peek_queue_depth"] = coord.peek_gate.depth
+        return sorted(counts.items())
     if name == "mz_arrangement_sizes":
         out = []
         for gid, df, _src in coord.dataflows:

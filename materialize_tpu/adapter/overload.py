@@ -1,0 +1,124 @@
+"""Admission control + overload accounting for the serving path.
+
+The analogue of the reference's coordinator message queue bounds and
+balancerd connection limits: the coordinator command loop is single-threaded
+(every frontend serializes through one lock), so under a client swarm the
+waiting line IS the work queue. An `AdmissionGate` bounds that line and
+sheds the overflow with a clean, retryable 53300 instead of letting latency
+(and per-thread stacks) grow without bound; `OverloadStats` makes every
+degradation decision countable so the saturation chaos tier can assert
+"queues stayed bounded" rather than assume it.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+from ..errors import AdmissionShed
+
+
+class OverloadStats:
+    """Thread-safe named counters for every shed/cancel/yield decision.
+
+    Queryable as the `mz_overload_counters` introspection relation, so
+    degradation is observable from SQL — not just from stderr.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counts: dict[str, int] = {}
+
+    def bump(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counts[name] = self._counts.get(name, 0) + by
+
+    def record_max(self, name: str, value: int) -> None:
+        with self._lock:
+            if value > self._counts.get(name, 0):
+                self._counts[name] = value
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counts.get(name, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+def looks_like_peek(sql: str) -> bool:
+    """Pre-parse read classification for the peek admission gate.
+
+    Heuristic by design (the real parse happens under the lock): leading
+    `--` line comments are skipped so a commented read can't slip past the
+    peek gate; a read-headed multi-statement script is gated as a peek."""
+    head = sql.lstrip()
+    while head.startswith("--"):
+        nl = head.find("\n")
+        if nl < 0:
+            return False
+        head = head[nl + 1 :].lstrip()
+    return head.lower().startswith(
+        ("select", "show", "explain", "copy", "values", "with", "(")
+    )
+
+
+@contextmanager
+def admitted(coord, sql: str, lock):
+    """THE admission discipline, shared by every frontend: the statement
+    gate, the (tighter) peek gate for peek-shaped scripts, then the
+    coordinator lock. Gates bound the waiting line BEFORE the lock — a shed
+    statement raises AdmissionShed (53300) without ever blocking. One
+    implementation so the frontends cannot drift."""
+    from contextlib import ExitStack
+
+    with ExitStack() as stack:
+        stack.enter_context(coord.admission.admit())
+        if looks_like_peek(sql):
+            stack.enter_context(coord.peek_gate.admit())
+        stack.enter_context(lock)
+        yield
+
+
+class AdmissionGate:
+    """Bounded waiting line in front of the coordinator lock.
+
+    `admit()` counts the caller into the line for the full duration of its
+    statement (waiting + executing). When the line is already at the
+    configured depth, the caller is shed immediately with AdmissionShed
+    (53300) — it never blocks, never grows the queue. depth_fn is consulted
+    per admission so `ALTER SYSTEM SET coord_queue_depth = …` takes effect
+    live; 0 disables the bound.
+    """
+
+    def __init__(self, name: str, depth_fn, stats: OverloadStats | None = None):
+        self.name = name
+        self._depth_fn = depth_fn
+        self._lock = threading.Lock()
+        self._inline = 0
+        self.stats = stats or OverloadStats()
+
+    @property
+    def depth(self) -> int:
+        """Current line length (waiting + executing statements)."""
+        with self._lock:
+            return self._inline
+
+    @contextmanager
+    def admit(self):
+        limit = int(self._depth_fn())
+        with self._lock:
+            if limit > 0 and self._inline >= limit:
+                self.stats.bump(f"{self.name}_sheds")
+                raise AdmissionShed(
+                    f"too many queued requests: {self.name} admission queue is "
+                    f"full ({self._inline}/{limit}); retry later"
+                )
+            self._inline += 1
+            self.stats.record_max(f"{self.name}_queue_peak", self._inline)
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._inline -= 1

@@ -464,6 +464,10 @@ def serve(host: str, port: int, mesh_port: int | None = None):
         state.mesh = WorkerMesh(host, mesh_port)
     srv = socket.create_server((host, port), reuse_port=False)
     srv.listen(4)
+    # listener hygiene: accept() in this sandbox is not interrupted by a
+    # listener close, so the loop must wake on a timeout to observe shutdown
+    # (here: the closed socket raising OSError on the next accept call)
+    srv.settimeout(1.0)
     print(f"clusterd listening on {host}:{port}", flush=True)
 
     def ident():
@@ -491,7 +495,12 @@ def serve(host: str, port: int, mesh_port: int | None = None):
             conn.close()
 
     while True:
-        conn, _addr = srv.accept()
+        try:
+            conn, _addr = srv.accept()
+        except socket.timeout:
+            continue
+        except OSError:
+            return  # listener closed: shut down the accept loop
         threading.Thread(target=client, args=(conn,), daemon=True).start()
 
 

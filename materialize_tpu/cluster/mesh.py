@@ -164,6 +164,9 @@ class WorkerMesh:
         self._pending: dict[int, dict[int, socket.socket]] = {}
         self._pending_cv = threading.Condition(self._lock)
         self._srv = socket.create_server((host, port))
+        # listener hygiene: close() does not interrupt a blocked accept() in
+        # this sandbox; the timeout wakes the loop so shutdown is observed
+        self._srv.settimeout(0.5)
         self.addr = self._srv.getsockname()
         threading.Thread(target=self._accept_loop, daemon=True).start()
 
@@ -179,6 +182,8 @@ class WorkerMesh:
         while True:
             try:
                 conn, _addr = self._srv.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
             threading.Thread(

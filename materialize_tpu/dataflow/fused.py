@@ -908,7 +908,12 @@ class FusedDataflow:
         return UpdateBatch.build((), cols, times, diffs, cap=delta_cap)
 
     # -- reads / maintenance (same surface as runtime.Dataflow) -------------
-    def peek(self, index_id: str, at: Optional[int] = None) -> list[tuple]:
+    def peek(
+        self,
+        index_id: str,
+        at: Optional[int] = None,
+        byte_budget: int | None = None,
+    ) -> list[tuple]:
         at = self.frontier - 1 if at is None else at
         acc: dict[tuple, int] = {}
         for data, _t, d in self.index_errs[index_id].rows_host(at):
@@ -920,7 +925,7 @@ class FusedDataflow:
         out: dict[tuple, int] = {}
         for data, _t, d in self.index_traces[index_id].rows_host(at):
             out[data] = out.get(data, 0) + d
-        return materialize_counts(out, index_id)
+        return materialize_counts(out, index_id, byte_budget=byte_budget)
 
     def compact(self, since: int) -> None:
         self.since = max(self.since, since)

@@ -1003,17 +1003,48 @@ def peek_row_key(row: tuple) -> tuple:
     return tuple((v is None, 0 if v is None else v) for v in row)
 
 
-def materialize_counts(acc: dict, label: str) -> list[tuple]:
+def row_bytes_estimate(data: tuple) -> int:
+    """Rough wire size of one result row — the accounting unit for
+    max_result_size budgets: tuple overhead + 8 B/column, plus the actual
+    payload of string/bytes values (decoded rows carry real strings; a flat
+    per-column charge would let a wide-TEXT result blow past the budget
+    unnoticed). Encoded rows hold dictionary codes (ints), where the flat
+    charge is exact."""
+    n = 16 + 8 * len(data)
+    for v in data:
+        if isinstance(v, (str, bytes)):
+            n += len(v)
+    return n
+
+
+def materialize_counts(
+    acc: dict, label: str, byte_budget: int | None = None
+) -> list[tuple]:
     """Expand {row: multiplicity} into sorted rows; negative multiplicities
     mean upstream inconsistency and error (the reference surfaces these as
-    'Invalid data in source, saw retractions' rather than masking)."""
+    'Invalid data in source, saw retractions' rather than masking).
+
+    `byte_budget` bounds the EXPANSION itself: a small consolidated trace can
+    carry huge multiplicities, so the max_result_size check must abort here —
+    mid-expansion, before the full result ever exists in memory — with the
+    canonical 53400, not after the list is built."""
+    from ..errors import ResultSizeExceeded
+
     rows: list[tuple] = []
+    spent = 0
     key = lambda kv: peek_row_key(kv[0])
     for data, cnt in sorted(acc.items(), key=key):
         if cnt < 0:
             raise RuntimeError(
                 f"peek {label}: negative multiplicity {cnt} for {data}"
             )
+        if byte_budget is not None and cnt:
+            spent += row_bytes_estimate(data) * cnt
+            if spent > byte_budget:
+                raise ResultSizeExceeded(
+                    f"result exceeds max_result_size ({byte_budget} bytes); "
+                    f"aborted after ~{len(rows)} rows"
+                )
         rows.extend([data] * cnt)
     return rows
 
@@ -1106,6 +1137,12 @@ class Dataflow:
         # (obj_id, op_idx) -> {type, elapsed_ns, invocations}; the analogue of
         # the reference's timely/compute introspection logs (SURVEY.md §5)
         self.metrics: dict = {}
+        # cooperative cancellation: when set (ephemeral peek dataflows), this
+        # callable runs between operator dispatches and raises QueryCanceled
+        # once the statement's deadline passed or a CancelRequest landed —
+        # the reference's PendingPeek cancellation points, but inside the
+        # host-orchestrated tick so a runaway peek can't wedge the one core
+        self.cancel_check = None
 
     # -- frontier ----------------------------------------------------------
     @property
@@ -1365,6 +1402,8 @@ class Dataflow:
         for obj_id, ops, out_ref in self.builds:
             slots: list[Delta] = []
             for op_i, (node, in_refs) in enumerate(ops):
+                if self.cancel_check is not None:
+                    self.cancel_check()
                 ins = [
                     (env.get(r) if isinstance(r, str) else slots[r]) for r in in_refs
                 ]
@@ -1399,7 +1438,12 @@ class Dataflow:
         self.frontier = tick + 1
         return results
 
-    def peek(self, index_id: str, at: Optional[int] = None) -> list[tuple]:
+    def peek(
+        self,
+        index_id: str,
+        at: Optional[int] = None,
+        byte_budget: int | None = None,
+    ) -> list[tuple]:
         """Snapshot read of an exported index at time `at` (default: latest
         complete time). The analogue of PendingPeek::Index cursor scans
         (src/compute/src/compute_state.rs:1273).
@@ -1435,7 +1479,7 @@ class Dataflow:
         out: dict[tuple, int] = {}
         for data, _t, d in self.index_traces[index_id].rows_host(at):
             out[data] = out.get(data, 0) + d
-        return materialize_counts(out, index_id)
+        return materialize_counts(out, index_id, byte_budget=byte_budget)
 
     def compact(self, since: int) -> None:
         for _obj, ops, _ref in self.builds:

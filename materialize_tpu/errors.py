@@ -1,0 +1,73 @@
+"""Shared SQL-surface error taxonomy (SQLSTATE-carrying exceptions).
+
+The overload-behavior contract: every way a statement can be refused or
+interrupted under load maps to ONE documented SQLSTATE, so clients can
+distinguish "retry later" (shed) from "your query was too expensive"
+(result size) from "someone canceled you / you ran out of time" (cancel).
+Mirrors the reference's use of pg error codes (src/pgwire/src/message.rs
+ErrorResponse severity/code fields; adapter errors carry SqlState):
+
+    57014  query_canceled            — statement_timeout fired, or a pgwire
+                                       CancelRequest with the right secret
+    53300  too_many_connections     — max_connections / admission-gate shed;
+                                       RETRYABLE: the queue was full, not the
+                                       statement wrong
+    53400  configuration_limit_exceeded — result would exceed max_result_size
+    57P05  idle_session_timeout     — idle_in_transaction_session_timeout
+                                       closed the connection
+
+This module sits below every layer (frontend, adapter, dataflow) so the
+dataflow tick loop can abort with the canonical code without importing the
+adapter.
+"""
+
+from __future__ import annotations
+
+
+class SqlError(Exception):
+    """Base for errors that carry a pg SQLSTATE to the wire."""
+
+    sqlstate = "XX000"
+    #: sheds are safe to retry verbatim; cancels/limits are not
+    retryable = False
+
+
+class QueryCanceled(SqlError):
+    """Cooperative cancellation: statement_timeout or CancelRequest (57014)."""
+
+    sqlstate = "57014"
+
+
+class AdmissionShed(SqlError):
+    """Load shed by an admission gate: the work queue was full (53300).
+
+    Retryable by contract — nothing about the statement itself was wrong."""
+
+    sqlstate = "53300"
+    retryable = True
+
+
+class TooManyConnections(SqlError):
+    """max_connections exceeded at accept time (53300, retryable)."""
+
+    sqlstate = "53300"
+    retryable = True
+
+
+class ResultSizeExceeded(SqlError):
+    """Result would exceed max_result_size; aborted before full
+    materialization (53400)."""
+
+    sqlstate = "53400"
+
+
+class IdleTimeout(SqlError):
+    """idle_in_transaction_session_timeout expired; the connection is
+    terminated (57P05)."""
+
+    sqlstate = "57P05"
+
+
+def sqlstate_of(exc: BaseException) -> str:
+    """SQLSTATE for any exception (internal_error for non-SqlErrors)."""
+    return getattr(exc, "sqlstate", "XX000")

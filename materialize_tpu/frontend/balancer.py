@@ -2,50 +2,163 @@
 
 The analogue of the reference's `mz-balancerd` (src/balancerd/src/lib.rs:9-12):
 a connection-level TCP proxy that spreads pgwire/HTTP clients across backend
-environments. No protocol awareness needed — it splices bytes both ways and
-removes itself from the failure story (stateless, restartable).
+environments. No protocol awareness needed for the splice — it moves bytes
+both ways and removes itself from the failure story (stateless, restartable).
+
+Health, however, needs a REAL round-trip: this sandbox's loopback stack lets
+`connect()` to a dead port succeed (failure only surfaces on first recv — see
+doc/ROADMAP.md known facts), so a bare-connect check would happily route
+clients into a black hole. Every candidate backend is probed with a
+request/response exchange first (the `ShardedComputeController._reachable`
+discipline); dead backends are skipped — saturated ones too under the
+protocol-aware probes (pg_probe/http_probe) — and a fully-dark backend set
+sheds the client instead of hanging it.
 """
 
 from __future__ import annotations
 
 import itertools
 import socket
+import struct
 import threading
+import time
+
+
+def recv_probe(addr, timeout: float = 0.1) -> bool:
+    """Protocol-neutral liveness round-trip: dial, then demand the kernel
+    prove a peer exists. A dead port here accepts the dial but EOFs/errors
+    on first recv; a live server simply has nothing to say yet, so the recv
+    times out — which IS the healthy signal.
+
+    Detects DEADNESS only (and pays `timeout` per cache-miss probe of a
+    healthy backend). A saturated-but-alive backend looks healthy here; use
+    the protocol-aware pg_probe/http_probe to shed those too."""
+    try:
+        with socket.create_connection(addr, timeout=1.0) as s:
+            s.settimeout(timeout)
+            try:
+                return bool(s.recv(1))  # unsolicited banner: alive
+            except socket.timeout:
+                return True  # connected and silent: alive
+    except OSError:
+        return False
+
+
+def pg_probe(addr, timeout: float = 1.0) -> bool:
+    """pgwire round-trip: SSLRequest → healthy servers answer b"N". A
+    saturated backend (max_connections) answers an ErrorResponse instead and
+    is skipped — shedding happens HERE, before a doomed splice."""
+    try:
+        with socket.create_connection(addr, timeout=timeout) as s:
+            s.settimeout(timeout)
+            s.sendall(struct.pack(">II", 8, 80877103))
+            return s.recv(1) == b"N"
+    except OSError:
+        return False
+
+
+def http_probe(addr, timeout: float = 1.0) -> bool:
+    """HTTP round-trip against the readiness endpoint."""
+    try:
+        with socket.create_connection(addr, timeout=timeout) as s:
+            s.settimeout(timeout)
+            s.sendall(b"GET /api/readyz HTTP/1.0\r\n\r\n")
+            head = s.recv(16)
+            return head.startswith(b"HTTP/1.") and b"200" in head
+    except OSError:
+        return False
 
 
 class Balancer:
-    def __init__(self, backends: list[tuple], host: str = "127.0.0.1", port: int = 0):
-        self.backends = list(backends)
+    def __init__(
+        self,
+        backends: list[tuple],
+        host: str = "127.0.0.1",
+        port: int = 0,
+        probe=None,
+        probe_ttl: float = 1.0,
+    ):
+        # normalize to tuples once: health cache and probe locks key on the
+        # address, and list-typed addrs are unhashable
+        self.backends = [tuple(a) for a in backends]
+        self.probe = probe or recv_probe
+        self.probe_ttl = probe_ttl
+        self._health: dict[tuple, tuple[bool, float]] = {}  # addr -> (ok, until)
+        # single-flight per backend: a connection burst after TTL expiry
+        # must not fan out into a probe storm against the same address
+        self._probe_locks: dict[tuple, threading.Lock] = {
+            tuple(a): threading.Lock() for a in self.backends
+        }
+        # counters are bumped from concurrent proxy threads; += is not atomic
+        self._stats_lock = threading.Lock()
+        self.skipped_backends = 0  # probes that ruled a backend out
+        self.shed_connections = 0  # clients closed with no healthy backend
         self._rr = itertools.count()
+        self._stop = threading.Event()
         self.srv = socket.create_server((host, port))
         self.srv.listen(64)
+        # accept() here is not interrupted by close (ROADMAP known facts):
+        # the timeout wakes the loop so the stop flag actually stops it
+        self.srv.settimeout(0.5)
         self.port = self.srv.getsockname()[1]
         self._thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._thread.start()
 
+    def _bump(self, name: str) -> None:
+        with self._stats_lock:
+            setattr(self, name, getattr(self, name) + 1)
+
     def _accept_loop(self):
-        while True:
+        while not self._stop.is_set():
             try:
                 conn, _ = self.srv.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
             threading.Thread(target=self._proxy, args=(conn,), daemon=True).start()
 
+    def _healthy(self, addr) -> bool:
+        """Probe with a short-TTL cache and per-backend single-flight:
+        concurrent pickers coalesce onto one probe, then read its result."""
+        lock = self._probe_locks.setdefault(tuple(addr), threading.Lock())
+        with lock:
+            now = time.monotonic()
+            cached = self._health.get(addr)
+            if cached is not None and cached[1] > now:
+                return cached[0]
+            ok = self.probe(addr)
+            self._health[addr] = (ok, now + self.probe_ttl)
+            return ok
+
     def _pick_backend(self):
-        # round-robin with failover: try every backend once
+        # round-robin with failover: try every backend once, but only after
+        # a request/response round-trip proves it answers (bare connect
+        # succeeds on dead ports in this sandbox)
         n = len(self.backends)
         start = next(self._rr)
         for k in range(n):
             addr = self.backends[(start + k) % n]
+            if not self._healthy(addr):
+                self._bump("skipped_backends")
+                continue
             try:
                 return socket.create_connection(addr, timeout=5)
             except OSError:
+                lock = self._probe_locks.setdefault(tuple(addr), threading.Lock())
+                with lock:  # same lock as _healthy: no stale-overwrite race
+                    self._health[addr] = (
+                        False, time.monotonic() + self.probe_ttl
+                    )
+                self._bump("skipped_backends")
                 continue
         return None
 
     def _proxy(self, client: socket.socket):
         upstream = self._pick_backend()
         if upstream is None:
+            # every backend dead/saturated: shed cleanly instead of hanging
+            self._bump("shed_connections")
             client.close()
             return
 
@@ -71,4 +184,5 @@ class Balancer:
         upstream.close()
 
     def close(self):
+        self._stop.set()
         self.srv.close()

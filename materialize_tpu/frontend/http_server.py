@@ -94,10 +94,19 @@ class SqlHandler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         if self.path == "/api/sql":
+            from ..errors import AdmissionShed, sqlstate_of
+
             try:
                 doc = self._read_body()
                 sql = doc.get("query", "")
-                with self.lock:
+                # same admission discipline as pgwire — literally the same
+                # implementation (adapter/overload.py `admitted`): the
+                # coordinator's waiting line is bounded across EVERY
+                # frontend; a shed returns 503 + retryable code instead of
+                # queuing forever
+                from ..adapter.overload import admitted
+
+                with admitted(self.coordinator, sql, self.lock):
                     results = self.coordinator.execute_script(sql)
                 out = []
                 for r in results:
@@ -116,7 +125,10 @@ class SqlHandler(BaseHTTPRequestHandler):
                         out.append({"ok": r.status})
                 return self._reply(200, {"results": out})
             except Exception as e:
-                return self._reply(400, {"error": str(e)})
+                code = 503 if isinstance(e, AdmissionShed) else 400
+                return self._reply(
+                    code, {"error": str(e), "code": sqlstate_of(e)}
+                )
         if self.path == "/api/promote":
             try:
                 with self.lock:
@@ -145,9 +157,27 @@ class SqlHandler(BaseHTTPRequestHandler):
             f"mzt_catalog_items {len(c.catalog.items)}",
             "# TYPE mzt_dataflows gauge",
             f"mzt_dataflows {len(c.dataflows)}",
-            "# TYPE mzt_operator_elapsed_ns counter",
+            "# TYPE mzt_overload_counter counter",
+        ]
+        for name, value in sorted(c.overload.snapshot().items()):
+            lines.append(f'mzt_overload_counter{{name="{name}"}} {value}')
+        lines += [
+            "# TYPE mzt_admission_queue_depth gauge",
+            f'mzt_admission_queue_depth{{gate="statement"}} {c.admission.depth}',
+            f'mzt_admission_queue_depth{{gate="peek"}} {c.peek_gate.depth}',
+            "# TYPE mzt_peek_duration_bucket counter",
         ]
         with self.lock:
+            # under the lock, and over a dict() snapshot (pgwire may hold a
+            # DIFFERENT lock): a concurrent _record_peek inserting a fresh
+            # bucket key mid-iteration would fault the scrape
+            for bucket, count in sorted(
+                dict(getattr(c, "peek_histogram", {})).items()
+            ):
+                lines.append(
+                    f'mzt_peek_duration_bucket{{le_ns="{bucket}"}} {count}'
+                )
+            lines.append("# TYPE mzt_operator_elapsed_ns counter")
             for gid, df, _src in c.dataflows:
                 for _obj, op_i, typ, el, inv in df.operator_info():
                     lines.append(

@@ -13,14 +13,22 @@ talk to this.
 
 from __future__ import annotations
 
+import itertools
+import secrets
 import socket
 import struct
 import threading
+import time
 
 from ..adapter import Coordinator, ExecResult
+from ..errors import IdleTimeout, TooManyConnections, sqlstate_of
 
 _SSL_REQUEST = 80877103
 _CANCEL_REQUEST = 80877102
+
+# backend pids are process-global: two listeners sharing one coordinator
+# must never hand out colliding (pid, secret) cancel identities
+_PIDS = itertools.count(1)
 _GSSENC_REQUEST = 80877104
 _PROTO_V3 = 196608
 
@@ -95,25 +103,63 @@ def _has_bare_semicolon(sql: str) -> bool:
 
 
 class PgConnection:
-    def __init__(self, sock: socket.socket, coordinator: Coordinator, lock):
+    def __init__(self, sock: socket.socket, coordinator: Coordinator, lock,
+                 server: "PgServer | None" = None):
         self.sock = sock
         self.coord = coordinator
         self.lock = lock
+        self.server = server
         self.session = coordinator.new_session()
+        # cancellation identity (BackendKeyData): a CancelRequest must quote
+        # this exact (pid, secret) pair; anything else is a silent no-op
+        self.pid = next(_PIDS)
+        self.secret = secrets.randbits(32)
+        coordinator.cancel_keys[self.pid] = (self.secret, self.session)
         # extended query protocol state (protocol.rs StateMachine analogue)
         self.statements: dict[str, str] = {}  # name -> sql with $n params
         self.portals: dict[str, tuple] = {}  # name -> (sql, bound param values)
         # after an error, skip messages until Sync (spec-mandated)
         self.in_error = False
 
+    def _admitted(self, sql: str):
+        """Shared admission discipline (adapter/overload.py `admitted`):
+        statement gate → peek gate for peek-shaped scripts → lock."""
+        from ..adapter.overload import admitted
+
+        return admitted(self.coord, sql, self.lock)
+
     # -- startup ---------------------------------------------------------------
     def run(self) -> None:
         try:
+            # startup budget: a dialed-but-silent connection counts against
+            # max_connections from accept, so it may not camp in the startup
+            # read forever — 30 s to produce a startup packet or the slot is
+            # reclaimed (socket.timeout lands in the outer handler below)
+            self.sock.settimeout(30.0)
             if not self._startup():
                 return
             self._send_ready()
             while True:
-                tag, payload = self._read_message()
+                # idle-session budget: a connection holding no statement may
+                # not camp forever (57P05). The timeout covers only the wait
+                # for a message's FIRST byte — a slow link mid-message or a
+                # slow reader mid-result is not idle. socket.timeout must be
+                # caught HERE — the outer OSError handler would mask it.
+                idle_ms = int(
+                    self.session.get("idle_in_transaction_session_timeout")
+                )
+                try:
+                    tag, payload = self._read_message(
+                        first_byte_timeout=idle_ms / 1000.0 if idle_ms > 0 else None
+                    )
+                except socket.timeout:
+                    self.coord.overload.bump("idle_timeouts")
+                    err = IdleTimeout(
+                        "terminating connection due to "
+                        "idle-in-transaction session timeout"
+                    )
+                    self._send_error(err.sqlstate, str(err))
+                    break
                 if tag is None or tag == b"X":
                     break
                 if tag == b"Q":
@@ -147,10 +193,36 @@ class PgConnection:
         except (ConnectionError, OSError):
             pass
         finally:
+            self.coord.cancel_keys.pop(self.pid, None)
+            if self.server is not None:
+                self.server.conn_done()
             try:
                 self.sock.close()
             except OSError:
                 pass
+
+    def _saturated(self) -> bool:
+        """max_connections admission: this connection counts itself."""
+        limit = int(self.coord.configs.get("max_connections"))
+        return (
+            limit > 0
+            and self.server is not None
+            and self.server.active_connections > limit
+        )
+
+    def _handle_cancel_request(self, body: bytes) -> None:
+        """CancelRequest: out-of-band, lock-free, secret-gated. The wrong
+        secret is a silent no-op (per spec: no response either way) — the
+        requester learns nothing about live pids."""
+        if len(body) < 12:
+            return
+        pid, secret = struct.unpack(">II", body[4:12])
+        entry = self.coord.cancel_keys.get(pid)
+        if entry is not None and entry[0] == secret:
+            entry[1].cancelled.set()
+            self.coord.overload.bump("cancel_requests")
+        else:
+            self.coord.overload.bump("cancel_requests_ignored")
 
     def _startup(self) -> bool:
         while True:
@@ -162,11 +234,22 @@ class PgConnection:
             if body is None:
                 return False
             (code,) = struct.unpack(">I", body[:4])
+            if code == _CANCEL_REQUEST:
+                # processed even at max_connections: a saturated server that
+                # refuses cancels could never be relieved by its own clients
+                self._handle_cancel_request(body)
+                return False
+            if self._saturated():
+                # shed at the first request/response exchange, so the
+                # balancer's round-trip probe (SSLRequest → expects 'N')
+                # sees saturation, not health; retryable by contract
+                self.coord.overload.bump("connections_rejected")
+                err = TooManyConnections("too many connections; retry later")
+                self._send_error(err.sqlstate, str(err))
+                return False
             if code in (_SSL_REQUEST, _GSSENC_REQUEST):
                 self.sock.sendall(b"N")  # no TLS; client retries cleartext
                 continue
-            if code == _CANCEL_REQUEST:
-                return False
             if code != _PROTO_V3:
                 self._send_error("08P01", f"unsupported protocol {code}")
                 return False
@@ -181,7 +264,8 @@ class PgConnection:
             ("standard_conforming_strings", "on"),
         ):
             self.sock.sendall(_msg(b"S", _cstr(k) + _cstr(v)))
-        self.sock.sendall(_msg(b"K", struct.pack(">II", 0, 0)))  # BackendKeyData
+        # BackendKeyData: the (pid, secret) a client must echo to cancel
+        self.sock.sendall(_msg(b"K", struct.pack(">II", self.pid, self.secret)))
         return True
 
     # -- messages --------------------------------------------------------------
@@ -194,8 +278,14 @@ class PgConnection:
             buf += chunk
         return buf
 
-    def _read_message(self):
-        tag = self._read_exact(1)
+    def _read_message(self, first_byte_timeout: float | None = None):
+        # the idle window applies only to the gap BETWEEN messages: once the
+        # tag byte arrives, the rest of the message reads untimed
+        self.sock.settimeout(first_byte_timeout)
+        try:
+            tag = self._read_exact(1)
+        finally:
+            self.sock.settimeout(None)
         if tag is None:
             return None, None
         head = self._read_exact(4)
@@ -218,11 +308,17 @@ class PgConnection:
             self.sock.sendall(_msg(b"I", b""))  # EmptyQueryResponse
             self._send_ready()
             return
+        # a cancel targets THIS query message (which may be a whole script):
+        # one left set by a race after the previous message is dropped now,
+        # pg-style; one landing any time during this script kills it (57014)
+        self.session.cancelled.clear()
+        # statement_timeout windows open at receipt: queue wait counts
+        self.session.arrival = time.monotonic()
         try:
-            with self.lock:
+            with self._admitted(sql):
                 results = self.coord.execute_script(sql, self.session)
         except Exception as e:
-            self._send_error("XX000", str(e))
+            self._send_error(sqlstate_of(e), str(e))
             self._send_ready()
             return
         self._send_results(results, with_description=True)
@@ -381,11 +477,13 @@ class PgConnection:
             self._ext_error("34000", f"unknown portal {portal!r}")
             return
         sql, params = entry
+        self.session.cancelled.clear()  # per Execute message, like _simple_query
+        self.session.arrival = time.monotonic()
         try:
-            with self.lock:
+            with self._admitted(sql):
                 results = self.coord.execute_script(sql, self.session, params=params)
         except Exception as e:
-            self._ext_error("XX000", str(e))
+            self._ext_error(sqlstate_of(e), str(e))
             return
         # per protocol, Execute emits DataRows only (RowDescription belongs
         # to Describe)
@@ -433,27 +531,80 @@ class PgConnection:
         self.sock.sendall(_msg(b"D", payload))
 
 
+class PgServer:
+    """pgwire listener: thread-per-connection behind connection admission.
+
+    Listener hygiene (ROADMAP known facts: this sandbox's `accept()` is NOT
+    interrupted by closing the listener): the server socket carries a
+    timeout, so the accept loop wakes periodically, observes the stop flag,
+    and exits — `close()` always terminates the thread. Connection counting
+    lives here; the per-connection max_connections shed happens inside
+    `PgConnection._startup` so CancelRequests still get through at the limit.
+    """
+
+    def __init__(self, coordinator: Coordinator, host: str, port: int,
+                 lock: threading.Lock):
+        self.coord = coordinator
+        self.lock = lock
+        self.stop = threading.Event()
+        self._count_lock = threading.Lock()
+        self.active_connections = 0
+        self.srv = socket.create_server((host, port))
+        self.srv.listen(64)
+        self.srv.settimeout(0.5)
+        self.thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self.thread.start()
+
+    # socket-compatible surface (tests and callers hold the old return shape)
+    def getsockname(self):
+        return self.srv.getsockname()
+
+    def close(self) -> None:
+        self.stop.set()
+        try:
+            self.srv.close()
+        except OSError:
+            pass
+
+    def conn_done(self) -> None:
+        with self._count_lock:
+            self.active_connections -= 1
+
+    def _accept_loop(self) -> None:
+        while not self.stop.is_set():
+            try:
+                conn, _addr = self.srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            with self._count_lock:
+                self.active_connections += 1
+            c = None
+            try:
+                c = PgConnection(conn, self.coord, self.lock, server=self)
+                threading.Thread(target=c.run, daemon=True).start()
+            except Exception:
+                # e.g. OS thread exhaustion under a connection storm: drop
+                # THIS connection, never the listener — an accept-loop death
+                # here would turn overload into a permanent outage
+                with self._count_lock:
+                    self.active_connections -= 1
+                if c is not None:
+                    self.coord.cancel_keys.pop(c.pid, None)
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+
 def serve_pgwire(
     coordinator: Coordinator,
     host: str = "127.0.0.1",
     port: int = 6877,
     lock: threading.Lock | None = None,
 ):
-    """Start the pgwire listener (thread-per-connection); returns the server
-    socket and its accept thread (daemon)."""
-    lock = lock or threading.Lock()
-    srv = socket.create_server((host, port))
-    srv.listen(16)
-
-    def accept_loop():
-        while True:
-            try:
-                conn, _addr = srv.accept()
-            except OSError:
-                return
-            c = PgConnection(conn, coordinator, lock)
-            threading.Thread(target=c.run, daemon=True).start()
-
-    t = threading.Thread(target=accept_loop, daemon=True)
-    t.start()
-    return srv, t
+    """Start the pgwire listener; returns (server, accept thread). The
+    server exposes getsockname()/close() like the raw socket it used to be."""
+    server = PgServer(coordinator, host, port, lock or threading.Lock())
+    return server, server.thread

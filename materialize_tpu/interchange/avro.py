@@ -280,17 +280,21 @@ def read_ocf_header(path: str):
 
 
 def read_blocks_from(
-    path: str, offset: int, schema, sync: bytes, max_records: int | None = None
+    path: str, offset: int, schema, sync: bytes, max_records: int | None = None,
+    max_bytes: int | None = None,
 ):
     """(records, new_offset, corrupt): decode COMPLETE blocks from `offset`.
 
     A truncated trailing block is left for the next poll (tail semantics);
     `max_records` stops BETWEEN blocks once reached, with new_offset on the
-    boundary, so a large backlog drains across polls instead of wedging. A
-    corrupt block (bad sync marker / undecodable payload) returns the good
-    records decoded so far with corrupt=True and new_offset at the bad
-    block's start — the caller skips past the next sync marker and counts
-    the error (consume-and-skip, like the line tailer)."""
+    boundary, so a large backlog drains across polls instead of wedging;
+    `max_bytes` (the ingest backpressure budget) likewise stops between
+    blocks once the consumed byte span reaches it — block-granular, so at
+    least one block always makes progress. A corrupt block (bad sync marker
+    / undecodable payload) returns the good records decoded so far with
+    corrupt=True and new_offset at the bad block's start — the caller skips
+    past the next sync marker and counts the error (consume-and-skip, like
+    the line tailer)."""
     size = os.path.getsize(path)
     records: list = []
     with open(path, "rb") as f:
@@ -300,6 +304,12 @@ def read_blocks_from(
             if start >= size:
                 break
             if max_records is not None and len(records) >= max_records:
+                return records, start, False
+            if (
+                max_bytes is not None
+                and records
+                and start - offset >= max_bytes
+            ):
                 return records, start, False
             try:
                 count = read_long(f)

@@ -69,29 +69,66 @@ class MemBlob(Blob):
 
 
 class FileBlob(Blob):
-    """Local-FS blob store with atomic writes (tmp + rename)."""
+    """Local-FS blob store with atomic, durable writes (tmp + fsync + rename
+    + directory fsync).
+
+    Key escaping is unambiguous percent-encoding: the old `"/" → "__"`
+    scheme collided with keys containing a literal `__` (list_keys would
+    round-trip them wrongly), and keys starting with "tmp" vanished behind
+    the mkstemp-scratch filter. Encoded names carry a `k_` prefix so they
+    can never collide with scratch files, and `unquote` inverts `quote`
+    exactly for every key.
+    """
+
+    _PREFIX = "k_"
 
     def __init__(self, root: str) -> None:
         self.root = root
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        safe = key.replace("/", "__")
-        return os.path.join(self.root, safe)
+        from urllib.parse import quote
+
+        return os.path.join(self.root, self._PREFIX + quote(key, safe=""))
+
+    def _legacy_path(self, key: str) -> str:
+        """Pre-percent-encoding layout ('/' → '__', no prefix): kept as a
+        read-only fallback so a data_dir written by an older build stays
+        readable after upgrade (writes always use the new scheme)."""
+        return os.path.join(self.root, key.replace("/", "__"))
 
     def get(self, key):
         try:
             with open(self._path(key), "rb") as f:
                 return f.read()
         except FileNotFoundError:
+            pass
+        try:
+            with open(self._legacy_path(key), "rb") as f:
+                return f.read()
+        except (FileNotFoundError, IsADirectoryError):
+            # ONLY not-found maps to None: a real I/O failure (EIO, EACCES)
+            # must surface loudly, not masquerade as a missing blob
             return None
 
     def set(self, key, value):
+        # Durability order matters: payload fsync BEFORE the rename, then the
+        # directory entry fsync. FileConsensus fsyncs the shard state that
+        # references this blob; without these two fsyncs an acked batch could
+        # vanish on power loss while the consensus pointer to it survives —
+        # breaking the definite-collection guarantee.
         fd, tmp = tempfile.mkstemp(dir=self.root)
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(value)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self._path(key))
+            dfd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
         except BaseException:
             try:
                 os.unlink(tmp)
@@ -100,24 +137,39 @@ class FileBlob(Blob):
             raise
 
     def delete(self, key):
-        try:
-            os.unlink(self._path(key))
-        except FileNotFoundError:
-            pass
+        for path in (self._path(key), self._legacy_path(key)):
+            try:
+                os.unlink(path)
+            except (FileNotFoundError, IsADirectoryError):
+                pass  # other OSErrors surface: GC must not count a
+                # still-existing blob as deleted
 
     def list_keys(self, prefix=""):
+        from urllib.parse import unquote
+
         out = []
         for name in os.listdir(self.root):
-            key = name.replace("__", "/")
-            if key.startswith(prefix) and not name.startswith("tmp"):
+            if name.startswith(self._PREFIX):
+                key = unquote(name[len(self._PREFIX):])
+            elif not name.startswith("tmp"):
+                # legacy-layout file: decode with the old (ambiguous) rule so
+                # pre-upgrade blobs stay visible to GC instead of leaking.
+                # Assumes no legacy KEY ever began with "k_" — true for every
+                # key this engine writes ("batch/…", shard gids).
+                key = name.replace("__", "/")
+            else:
+                continue  # mkstemp scratch files
+            if key.startswith(prefix):
                 out.append(key)
         return sorted(out)
 
     def stat_mtime(self, key):
-        try:
-            return os.stat(self._path(key)).st_mtime
-        except FileNotFoundError:
-            return None
+        for path in (self._path(key), self._legacy_path(key)):
+            try:
+                return os.stat(path).st_mtime
+            except FileNotFoundError:
+                continue
+        return None
 
 
 @dataclass

@@ -346,6 +346,14 @@ class ShowVariable:
 
 
 @dataclass(frozen=True)
+class ResetVariable:
+    """RESET <name>: drop the session override, falling back to the system
+    value (pg RESET; the session-vars half of overload budgeting)."""
+
+    name: str
+
+
+@dataclass(frozen=True)
 class Copy:
     """COPY (query | table) TO STDOUT [WITH (FORMAT CSV)]."""
 

@@ -102,6 +102,11 @@ class Parser:
                 self.expect_op("=")
             t = self.next()
             return ast.SetVariable(name, t.value, system=False)
+        if self.at_kw("reset") or (
+            self.peek().kind == "IDENT" and self.peek().value == "reset"
+        ):
+            self.next()
+            return ast.ResetVariable(self.ident())
         if self.peek().kind == "IDENT" and self.peek().value == "copy":
             self.next()
             if self.eat_op("("):

@@ -1,0 +1,79 @@
+"""Byte-budgeted source ingest: ticks pull a bounded amount, sources yield.
+
+The PanJoin-style principle (PAPERS.md, arXiv:1811.05065) applied to the
+ingest side: input rates are bursty, so the per-tick work must be bounded by
+the ENGINE's budget, not by whatever the external system managed to
+accumulate. One `IngestBudget` spans a whole `Coordinator.advance()` tick;
+every source asks it for a row/byte grant before generating or reading, and
+a source with more data left simply stops — the remainder is picked up by a
+later tick, offsets/remap bindings never run ahead (the reclocking
+discipline already guarantees exactly-once across the split).
+
+The min-one-record rule prevents livelock AND starvation: a single record
+wider than the remaining budget — or arriving after the budget is spent —
+is still granted (and charged over budget), so every source makes at least
+one record of progress per tick regardless of how hungry the sources before
+it were; per-tick growth stays bounded by budget + one record per source.
+"""
+
+from __future__ import annotations
+
+
+class IngestBudget:
+    """Per-tick byte allowance shared by every source of one coordinator.
+
+    `grant_rows(row_bytes, want)` → how many rows the source may emit now
+    (never 0 for want ≥ 1: the liveness floor grants one record past a
+    spent budget); the grant is charged immediately.
+    `charge(nbytes)` accounts work whose size is only known after the fact
+    (file reads). `yields` counts every time a source got less than it
+    wanted — the backpressure signal surfaced in mz_overload_counters.
+    """
+
+    def __init__(self, total_bytes: int):
+        self.total = int(total_bytes)
+        self.spent = 0
+        self.yields = 0
+
+    @property
+    def enabled(self) -> bool:
+        return self.total > 0
+
+    @property
+    def remaining(self) -> int | None:
+        """Bytes left, or None when budgeting is off."""
+        if not self.enabled:
+            return None
+        return max(0, self.total - self.spent)
+
+    def grant_rows(self, row_bytes: int, want: int) -> int:
+        if not self.enabled or want <= 0:
+            return want
+        rem = self.total - self.spent
+        # min-one-record progress doubles as the LIVENESS FLOOR: even a
+        # fully spent budget grants one row (charged past the line), so a
+        # hungry early source can only slow later ones down, never starve
+        # them tick after tick — per-tick growth stays bounded by
+        # budget + one record per source
+        n = min(want, max(1, rem // max(1, row_bytes)))
+        if n < want:
+            self.yields += 1
+        self.spent += n * max(1, row_bytes)
+        return n
+
+    def charge(self, nbytes: int) -> None:
+        self.spent += max(0, int(nbytes))
+
+    def note_yield(self) -> None:
+        """A source observed more pending data than its grant covered."""
+        self.yields += 1
+
+
+def batch_bytes_estimate(batch) -> int:
+    """Rough device/host footprint of an UpdateBatch delta (live rows ×
+    (value cols + time + diff) × 8 B)."""
+    try:
+        n = int(batch.count())
+    except Exception:
+        return 0
+    return n * (len(batch.vals) + 2) * 8

@@ -46,23 +46,38 @@ class FileTailSource:
     offset: int = 0  # committed byte offset (set from the remap shard)
     decode_errors: int = 0  # malformed lines skipped (dead-letter counter)
 
-    def poll(self, max_records: int = 10_000):
+    def poll(self, max_records: int = 10_000, max_bytes: int | None = None):
         """(records, new_offset): records are dicts col_name -> raw value
         (None = SQL NULL). Only COMPLETE lines are consumed; a partial
         trailing line stays for the next poll (the external writer may be
         mid-append). Malformed lines are consumed-and-skipped (counted in
-        decode_errors) — one bad record must never wedge ingestion."""
+        decode_errors) — one bad record must never wedge ingestion.
+
+        `max_bytes` is the ingest-backpressure cap (storage/backpressure.py):
+        at most that many bytes are read this poll; the rest of the file
+        waits for a later tick. A single line longer than the cap is still
+        consumed whole (min-one-record progress — a capped read that yields
+        no complete line would otherwise wedge the source forever). Avro
+        sources apply the cap at block granularity (one whole block always
+        makes progress)."""
         if self.spec.fmt == "avro":
-            return self._poll_avro(max_records)
+            return self._poll_avro(max_records, max_bytes)
         try:
             size = os.path.getsize(self.spec.path)
         except FileNotFoundError:
             return [], self.offset
         if size <= self.offset:
             return [], self.offset
+        want = size - self.offset
+        if max_bytes is not None and 0 <= max_bytes < want:
+            want = max(1, int(max_bytes))
         with open(self.spec.path, "rb") as f:
             f.seek(self.offset)
-            chunk = f.read(size - self.offset)
+            chunk = f.read(want)
+            if b"\n" not in chunk and size - self.offset > len(chunk):
+                # the cap split a single long line: extend to its newline
+                # (one over-budget record beats zero progress)
+                chunk += f.readline()
         records = []
         consumed = 0
         # Split strictly on b'\n': splitlines() also breaks on \r, \v, \f,
@@ -84,7 +99,7 @@ class FileTailSource:
                 self.decode_errors += 1
         return records, self.offset + consumed
 
-    def _poll_avro(self, max_records: int):
+    def _poll_avro(self, max_records: int, max_bytes: int | None = None):
         """Tail an Avro object container file block-by-block: the committed
         offset sits on a block boundary (or 0 = before the header); a
         truncated trailing block defers to the next poll — the same
@@ -103,7 +118,8 @@ class FileTailSource:
             return [], self.offset  # header incomplete: retry later
         start = max(self.offset, header_end)
         raw, new_off, corrupt = avro.read_blocks_from(
-            self.spec.path, start, schema, sync, max_records=max_records
+            self.spec.path, start, schema, sync, max_records=max_records,
+            max_bytes=max_bytes,
         )
         if corrupt:
             # consume-and-skip: hop past the next sync marker so one bad

@@ -39,6 +39,9 @@ class AuctionGenerator:
     static organizations/users/accounts; a stream of auctions and bids.
     """
 
+    # per-bid footprint for ingest budgeting (5 i64 cols + time/diff)
+    ROW_BYTES = 56
+
     def __init__(self, seed: int = 0, n_auctions_per_tick: int = 4, dict_: StringDictionary | None = None):
         self.rng = np.random.default_rng(seed)
         self.dict = dict_ or StringDictionary()
@@ -96,6 +99,8 @@ class AuctionGenerator:
 class CounterGenerator:
     """COUNTER load generator (load_generator.rs:150-155): emits 1, 2, 3, …;
     with max_cardinality, value v-max is retracted when v is emitted."""
+
+    ROW_BYTES = 24  # one i64 col + time/diff
 
     def __init__(self, max_cardinality: int | None = None):
         self.max_cardinality = max_cardinality

@@ -67,6 +67,8 @@ class KeyValueGenerator:
     UPSERT workload. Emits via UpsertState, so downstream sees clean diffs.
     """
 
+    ROW_BYTES = 48  # key + value i64 pair, doubled for the retraction diff
+
     def __init__(self, keys: int = 100, seed: int = 0, tombstone_frac: float = 0.05):
         self.n_keys = keys
         self.rng = np.random.default_rng(seed)

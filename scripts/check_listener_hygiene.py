@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Listener hygiene check: every accept loop must be shutdown-capable.
+
+This sandbox's network stack does NOT interrupt a thread blocked in
+``accept()`` when the listening socket is closed (doc/ROADMAP.md known
+facts) — a raw ``while True: srv.accept()`` loop therefore leaks its thread
+forever and can hold the process open. The fix pattern is mechanical, so
+this check enforces it: every file under materialize_tpu/frontend/ and
+materialize_tpu/cluster/ that calls ``.accept(`` must ALSO
+
+  1. set a timeout on the listener (``settimeout(``) so the loop wakes
+     periodically, and
+  2. handle ``socket.timeout`` (the wake-up), and
+  3. handle ``OSError`` (the closed-listener exit — the shutdown path).
+
+Files using stdlib servers (http.server's serve_forever is selector-driven
+and shutdown()-capable) don't contain a literal ``.accept(`` and pass
+automatically. Run: python scripts/check_listener_hygiene.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCAN_DIRS = [
+    os.path.join(REPO, "materialize_tpu", "frontend"),
+    os.path.join(REPO, "materialize_tpu", "cluster"),
+]
+
+REQUIRED = {
+    "listener timeout": "settimeout(",
+    "timeout wake-up handler": "except socket.timeout",
+    "closed-listener shutdown path": "except OSError",
+}
+
+
+def check_file(path: str) -> list[str]:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    if ".accept(" not in text:
+        return []
+    return [
+        f"{os.path.relpath(path, REPO)}: accept loop lacks {what} ({needle!r})"
+        for what, needle in REQUIRED.items()
+        if needle not in text
+    ]
+
+
+def main() -> int:
+    problems: list[str] = []
+    scanned = 0
+    for d in SCAN_DIRS:
+        for name in sorted(os.listdir(d)):
+            if not name.endswith(".py"):
+                continue
+            scanned += 1
+            problems.extend(check_file(os.path.join(d, name)))
+    if problems:
+        print("listener hygiene violations:", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 1
+    print(f"listener hygiene: {scanned} files clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
