@@ -1,10 +1,16 @@
-"""CLI: `python -m materialize_tpu serve|sql` — the environmentd/psql analogue.
+"""CLI: `python -m materialize_tpu serve|sql|fsck` — the environmentd/psql
+analogue.
 
   serve --port 6875 [--data-dir DIR] [--advance-every SECS [--rows N]]
       Start the HTTP SQL frontend (POST /api/sql). With --advance-every,
       load-generator sources tick continuously.
   sql [--url http://127.0.0.1:6875]
       Interactive SQL shell against a running server.
+  fsck --data-dir DIR [--json]
+      Offline durability invariant check (persist/fsck.py): exit 0 when no
+      fatal findings (missing/corrupt referenced blobs, undecodable or
+      newer-format catalog), 1 otherwise. Orphans, frontier anomalies and
+      txn-wal skew are reported but not fatal.
 """
 
 from __future__ import annotations
@@ -84,6 +90,30 @@ def cmd_serve(args) -> None:
     httpd.serve_forever()
 
 
+def cmd_fsck(args) -> None:
+    from .persist.fsck import fsck_data_dir
+
+    try:
+        report = fsck_data_dir(args.data_dir)
+    except FileNotFoundError as exc:
+        print(f"fsck: {exc}", file=sys.stderr)
+        sys.exit(2)
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "ok": report.ok,
+                    "shards_checked": report.shards_checked,
+                    "batches_checked": report.batches_checked,
+                    "findings": [f.as_dict() for f in report.findings],
+                }
+            )
+        )
+    else:
+        print(report.render())
+    sys.exit(0 if report.ok else 1)
+
+
 def cmd_sql(args) -> None:
     def run(q: str):
         req = urllib.request.Request(
@@ -141,6 +171,10 @@ def main() -> None:
     q = sub.add_parser("sql")
     q.add_argument("--url", default="http://127.0.0.1:6875")
     q.set_defaults(fn=cmd_sql)
+    f = sub.add_parser("fsck")
+    f.add_argument("--data-dir", required=True)
+    f.add_argument("--json", action="store_true")
+    f.set_defaults(fn=cmd_fsck)
     args = p.parse_args()
     args.fn(args)
 

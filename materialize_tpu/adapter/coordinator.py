@@ -66,6 +66,52 @@ def parse_replica_size(size: str) -> tuple[int, int]:
     return procs, workers
 
 
+def _migrate_catalog_v1(doc: dict) -> dict:
+    """v1 (unstamped) → v2: normalize item fields added over the format's
+    life, so the post-migration doc satisfies the v2 schema exactly."""
+    for d in doc.get("items", []):
+        d.setdefault("append_only", False)
+        d.setdefault("options", ())
+        d.setdefault("generator", None)
+    return doc
+
+
+_CATALOG_MIGRATIONS = {1: _migrate_catalog_v1}
+
+
+def _migrate_catalog_doc(doc: dict) -> dict:
+    """Upgrade a durable catalog doc to the current format version.
+
+    Older versions migrate step-by-step; a NEWER version refuses to boot
+    with a clear error — misreading a future format would corrupt the
+    catalog on the next persist (the reference's durable-catalog version
+    gate, src/catalog/src/durable/upgrade.rs)."""
+    from ..persist import CATALOG_VERSION
+
+    version = doc.get("version", 1)
+    if version > CATALOG_VERSION:
+        raise RuntimeError(
+            f"catalog format v{version} is newer than this build supports "
+            f"(v{CATALOG_VERSION}): refusing to boot; upgrade the binary "
+            "or point at a compatible data_dir"
+        )
+    while version < CATALOG_VERSION:
+        doc = _CATALOG_MIGRATIONS[version](doc)
+        version += 1
+        doc["version"] = version
+    return doc
+
+
+def _batch_to_cols(batch: UpdateBatch) -> dict:
+    """Host column dict ({'c0':…, 'times':…, 'diffs':…}) from a device
+    batch — the persist wire layout (shard.py encode_columns)."""
+    h = batch.to_host()
+    cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
+    cols["times"] = h["times"]
+    cols["diffs"] = h["diffs"]
+    return cols
+
+
 class StorageCollection:
     """Host-side durable collection of update batches (persist-lite).
 
@@ -135,6 +181,14 @@ class Coordinator:
 
             self.blob = FileBlob(f"{data_dir}/blob")
             self.consensus = FileConsensus(f"{data_dir}/consensus")
+        # crash-point injection (persist/crashpoints.py): when a CrashPlan is
+        # installed — by a test, or via MZT_CRASH_SPEC in a subprocess — every
+        # durable op goes through the seeded crash schedule
+        from ..persist import crashpoints
+
+        self.blob, self.consensus = crashpoints.wrap_if_installed(
+            self.blob, self.consensus
+        )
         self.shards: dict[str, object] = {}  # gid -> ShardMachine
         # name -> (controller, orchestrator, owned) — see create_compute_replica
         self._compute_replicas: dict[str, tuple] = {}
@@ -145,7 +199,7 @@ class Coordinator:
         self.epoch = 0
         self._register_introspection()
         if self.durable:
-            self._boot()
+            self._boot(read_only=preflight)
             if preflight:
                 self.deploy_state = "catching-up"
             else:
@@ -602,11 +656,25 @@ class Coordinator:
         results = df.step(as_of, snaps)
         self.storage[gid] = StorageCollection(pq.desc.dtypes)
         out = results.get(gid)
+        item.mir = rel
+        # in-memory state completes FIRST: a transient persist failure below
+        # must leave a fully functional MV (dataflow installed, storage
+        # hydrated), not a durable catalog entry whose view never updates
         if out is not None and out[0] is not None:
             self.storage[gid].append(out[0], as_of)
         self.dataflows.append((gid, df, src_gids))
-        item.mir = rel
+        # then catalog before hydration (the _apply_writes ordering rule: a
+        # crash between the two persists must leave an MV the next boot can
+        # see and reconcile — the reverse order would orphan a hydrated
+        # shard whose gid a retried CREATE re-allocates)
         self._persist_catalog()
+        if self.durable and out is not None and out[0] is not None:
+            # the hydration snapshot goes to the DURABLE shard too: the
+            # shard is what external readers (clusterd, fsck, the crash
+            # matrix) see, and it must never start life diverged from the
+            # in-memory collection (crash-matrix finding; a failure here
+            # heals at the next boot's _reconcile_mv_shard)
+            self._persist_batches({gid: out[0]}, as_of)
         return ExecResult("status", status="CREATE MATERIALIZED VIEW")
 
     def _create_index(self, stmt: ast.CreateIndex) -> ExecResult:
@@ -908,8 +976,14 @@ class Coordinator:
                     "append_only": it.append_only,
                 }
             )
+        from ..persist import CATALOG_VERSION
+
         doc = pickle.dumps(
             {
+                # format version stamp: _boot migrates older docs forward and
+                # REFUSES docs stamped by a newer build (a downgrade must
+                # fail loudly, not misread the catalog)
+                "version": CATALOG_VERSION,
                 "items": items,
                 "strings": list(self.catalog.dict._strs),
                 "ts": self.oracle.read_ts(),
@@ -930,18 +1004,27 @@ class Coordinator:
         load-generator sources; table/MV data is crash-consistent via shards)."""
         self._persist_catalog()
 
-    def _boot(self) -> None:
-        """Restart: reload catalog, rehydrate storage, re-render dataflows."""
+    def _boot(self, read_only: bool = False) -> None:
+        """Restart: reload catalog, rehydrate storage, re-render dataflows.
+
+        Re-entrant by construction: every step is idempotent (txn apply
+        checks shard uppers, rehydration reads, MV reconciliation diffs), so
+        a crash ANYWHERE in here converges on the next boot — the
+        crash-during-recovery half of the crash matrix. `read_only`
+        (preflight/catching-up instances) skips the one writing step, the
+        durable MV reconciliation."""
         import itertools
         import pickle
 
         head = self.consensus.head("catalog")
         if head is None:
             return
+        # version gate BEFORE any recovery work: a catalog stamped by a
+        # newer build must refuse to boot without touching anything
+        doc = _migrate_catalog_doc(pickle.loads(head.data))
         # txn-wal recovery FIRST: a crash between a multi-shard commit's
         # txns append and its apply must not leave data shards behind the log
         self._txn_machine().apply_up_to(1 << 62)
-        doc = pickle.loads(head.data)
         self.catalog._next_id = doc["next_id"]
         for s in doc["strings"]:
             self.catalog.dict.encode(s)
@@ -980,7 +1063,35 @@ class Coordinator:
                     self.oracle.apply_write(up - 1)
         for item in mvs:
             self.storage[item.global_id] = StorageCollection(item.desc.dtypes)
-            self._reinstall_mv(item)
+            self._reinstall_mv(item, reconcile=not read_only)
+        # shard reconciliation may have minted correction times beyond the
+        # pre-boot read frontier: every dataflow must observe time passing
+        # or a peek at the new read_ts errors as incomplete
+        ts = self.oracle.read_ts()
+        for mv_gid, df, _src in self.dataflows:
+            if df.frontier <= ts:
+                if df.has_temporal:
+                    # temporal dataflows emit real deltas (window expiries
+                    # due in (as_of, ts]) when time passes — append them to
+                    # storage and the durable shard exactly as the quiet
+                    # path of _apply_writes would, not just bump the
+                    # frontier (dropping them would bake expired rows into
+                    # the collection external readers hydrate)
+                    results = df.step(ts, {})
+                    out = results.get(mv_gid)
+                    if out is not None and out[0] is not None:
+                        self.storage[mv_gid].append(out[0], ts)
+                        if not read_only:
+                            m = self._shard(mv_gid)
+                            lower = m.upper()
+                            if lower < ts + 1:
+                                # epoch=None: pre-leadership, like
+                                # _reconcile_mv_shard
+                                m.compare_and_append(
+                                    _batch_to_cols(out[0]), lower, ts + 1
+                                )
+                else:
+                    df.frontier = ts + 1
 
     def _rehydrate_collection(self, gid: str) -> None:
         from ..persist import ShardMachine
@@ -998,7 +1109,7 @@ class Coordinator:
             store.arr.insert(batch)
         store.upper = state.upper
 
-    def _reinstall_mv(self, item: CatalogItem) -> None:
+    def _reinstall_mv(self, item: CatalogItem, reconcile: bool = True) -> None:
         """Re-plan + re-render an MV and hydrate from input snapshots."""
         from ..sql.lower import lower_to_dataflow as _lower
         from ..transform import optimize as _opt
@@ -1025,6 +1136,70 @@ class Coordinator:
         if out is not None and out[0] is not None:
             self.storage[gid].append(out[0], as_of)
         self.dataflows.append((gid, df, src_gids))
+        if reconcile:
+            self._reconcile_mv_shard(gid, as_of)
+
+    def _reconcile_mv_shard(self, gid: str, as_of: int) -> None:
+        """Boot-time self-correction of an MV's DURABLE shard.
+
+        The in-memory collection is recomputed from base snapshots at boot,
+        so it is always right — but the durable shard is appended as a side
+        effect of each tick, and a crash between the base-shard commit and
+        the derived persist leaves it missing that tick's delta FOREVER:
+        the in-tick `_mv_sink_correct` diffs desired against the (correct,
+        recomputed) memory collection and finds nothing to heal. Found by
+        the crash matrix; fixed by diffing desired against the SHARD here
+        and appending one correction, exactly like the reference's
+        self-correcting persist_sink but at boot. Idempotent (an empty diff
+        appends nothing), so a crash mid-reconciliation just reruns it."""
+        m = self._shard(gid)
+        _seq, state = m.fetch_state()
+        desired = self.storage[gid].snapshot(as_of)
+        persisted_cols = (
+            m.snapshot(max(state.upper - 1, 0)) if state.upper > 0 else []
+        )
+        if not persisted_cols and desired.count() == 0:
+            return  # both empty: nothing to reconcile
+        store = self.storage[gid]
+        persisted = [
+            UpdateBatch.build(
+                (),
+                tuple(cols[f"c{i}"] for i in range(len(store.dtypes))),
+                cols["times"],
+                cols["diffs"],
+            )
+            for cols in persisted_cols
+        ]
+        t_corr = max(as_of, state.upper)
+        correction = self._diff_correction(desired, persisted, t_corr)
+        n = int(correction.count())
+        if not n:
+            return
+        import sys
+
+        print(
+            f"WARNING: boot mv shard reconciliation: durable shard {gid} "
+            f"diverged from its recomputed view by {n} rows; healing",
+            file=sys.stderr,
+        )
+        # epoch=None: reconciliation runs pre-leadership (before the fence
+        # bump); read_only boots skip it entirely
+        m.compare_and_append(_batch_to_cols(correction), state.upper, t_corr + 1)
+        self.oracle.apply_write(t_corr)
+
+    def _diff_correction(self, desired, persisted: list, t: int):
+        """(desired − Σ persisted) advanced to `t`, consolidated: the one
+        correction-delta kernel behind both self-correction paths (the
+        in-tick _mv_sink_correct and boot's _reconcile_mv_shard). The crash
+        matrix's mv_shard_divergence deliberately does NOT share this code —
+        an independent host-side implementation is what makes it a check."""
+        from ..dataflow.runtime import negate_batch
+        from ..ops.consolidate import advance_times, consolidate
+
+        merged = desired
+        for p in persisted:
+            merged = UpdateBatch.concat(merged, negate_batch(p))
+        return consolidate(advance_times(merged, t))
 
     def _mono_ids(self) -> set:
         return {
@@ -1220,16 +1395,9 @@ class Coordinator:
         idx = f"idx_{mv_gid}"
         if idx not in df.index_traces or mv_gid not in self.storage:
             return None
-        from ..dataflow.runtime import negate_batch
-        from ..ops.consolidate import advance_times, consolidate
-
         desired = df.index_traces[idx].merged()
         persisted = self.storage[mv_gid].snapshot(ts)
-        correction = consolidate(
-            advance_times(
-                UpdateBatch.concat(desired, negate_batch(persisted)), ts
-            )
-        )
+        correction = self._diff_correction(desired, [persisted], ts)
         n = int(correction.count())
         if not n:
             return None
@@ -1256,15 +1424,8 @@ class Coordinator:
     ) -> None:
         from ..persist import Fenced
 
-        def to_cols(batch):
-            h = batch.to_host()
-            cols = {f"c{i}": c for i, c in enumerate(h["vals"])}
-            cols["times"] = h["times"]
-            cols["diffs"] = h["diffs"]
-            return cols
-
         try:
-            all_cols = {gid: to_cols(b) for gid, b in batches.items()}
+            all_cols = {gid: _batch_to_cols(b) for gid, b in batches.items()}
             all_cols.update(extra_shards or {})
             if atomic and len(all_cols) > 1:
                 # multi-shard statement: one txn-wal commit is the
@@ -1364,28 +1525,37 @@ class Coordinator:
         # remap alone (all polled lines blank/malformed) still commits: the
         # binding must advance src.offset or the same bytes are re-read and
         # re-counted in decode_errors every tick (advisor r2, low)
-        if writes or remap:
-            durable_point_passed = False
+        if not writes and not remap:
+            # a quiet tick must still advance the dataflow frontiers: the
+            # oracle's write_ts above already moved read_ts forward, and an
+            # MV peek at read_ts >= frontier errors as incomplete — a tick
+            # that ingests nothing would wedge every MV read until the next
+            # real write (crash-matrix finding). Leaders only: a preflight/
+            # fenced instance must not trip the read-only write guard.
+            if self.deploy_state == "leader":
+                self._apply_writes({}, ts)
+            return ts
+        durable_point_passed = False
 
-            def _advance_sources():
-                nonlocal durable_point_passed
-                durable_point_passed = True
-                for src, new_offset, _backup in committed:
-                    src.offset = new_offset
+        def _advance_sources():
+            nonlocal durable_point_passed
+            durable_point_passed = True
+            for src, new_offset, _backup in committed:
+                src.offset = new_offset
 
-            try:
-                self._apply_writes(
-                    writes, ts, extra_shards=remap, on_durable=_advance_sources
-                )
-            except Exception:
-                if not durable_point_passed:
-                    # nothing was committed: roll the pollers back so the
-                    # records are re-polled next tick (offsets/upsert state
-                    # must never run ahead of the durable remap binding)
-                    for src, _new_offset, backup in committed:
-                        if backup is not None:
-                            backup[0].state = backup[1]
-                raise
+        try:
+            self._apply_writes(
+                writes, ts, extra_shards=remap, on_durable=_advance_sources
+            )
+        except Exception:
+            if not durable_point_passed:
+                # nothing was committed: roll the pollers back so the
+                # records are re-polled next tick (offsets/upsert state
+                # must never run ahead of the durable remap binding)
+                for src, _new_offset, backup in committed:
+                    if backup is not None:
+                        backup[0].state = backup[1]
+            raise
         return ts
 
     # -- compute replicas ------------------------------------------------------

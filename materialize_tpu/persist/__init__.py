@@ -8,11 +8,33 @@ from .location import (
     UnreliableBlob,
     UnreliableConsensus,
 )
-from .shard import Fenced, ShardMachine, ShardState, UpperMismatch
+# Newest durable-catalog format this build reads/writes. The coordinator
+# stamps it on every persist; _boot migrates older docs forward and REFUSES
+# newer ones; fsck reports a newer stamp as fatal. Defined here (not in a
+# consumer module) so bumping the format is one edit at the package root.
+CATALOG_VERSION = 2
+
+from .crashpoints import (
+    CrashPlan,
+    CrashPointBlob,
+    CrashPointConsensus,
+    CrashPointReached,
+)
+from .fsck import FsckReport, fsck, fsck_data_dir
+from .shard import CorruptBlob, Fenced, ShardMachine, ShardState, UpperMismatch
 from .txn import TxnsMachine
 
 __all__ = [
+    "CATALOG_VERSION",
     "TxnsMachine",
+    "CorruptBlob",
+    "CrashPlan",
+    "CrashPointBlob",
+    "CrashPointConsensus",
+    "CrashPointReached",
+    "FsckReport",
+    "fsck",
+    "fsck_data_dir",
     "Blob",
     "Consensus",
     "FileBlob",

@@ -1,0 +1,284 @@
+"""Deterministic crash-point injection for the durability substrate.
+
+The storage-plane sibling of `cluster/faults.py` (and the deterministic
+upgrade of this module's probabilistic `UnreliableBlob`/`UnreliableConsensus`
+neighbors, which mirror the reference's src/persist/src/unreliable.rs): a
+`CrashPlan` wraps Blob/Consensus with *labeled, counted* durable operations —
+`blob.set`, `blob.delete`, `cas` — and simulates a whole-process crash at
+exactly one of them. Three crash shapes:
+
+- **before**: the process dies before the op touches disk (the op never
+  happened);
+- **after**: the op IS durable but the caller never learns it (the classic
+  acked-write-lost-ack window — e.g. a committed CAS whose success the
+  writer never observed);
+- **torn** (`blob.set` only): a truncated prefix of the payload lands at the
+  key, then the process dies — the weak-filesystem case FileBlob's
+  fsync+rename discipline is supposed to make unreachable for *referenced*
+  blobs.
+
+Determinism contract: every durable op gets a global 1-based index `n` in
+process order, and the crash shape at index `n` is a pure function of
+`(seed, op-label, n)` — so one `CRASH_SEED` + op index replays the exact
+same crash. The ops actually performed are recorded in `plan.trace`
+(`(n, label, key, decision)`), and optionally streamed to `trace_path` so a
+parent process can read the durable-op schedule even after the child dies.
+
+Crash mechanics: in-process plans raise `CrashPointReached`, which derives
+from **BaseException** on purpose — the durability code's crash-hazard
+cleanup handlers (`except Exception` in `compare_and_append`/`commit`) must
+NOT run, exactly as they would not after a real SIGKILL. Subprocess plans
+(`hard=True`, shipped via the `MZT_CRASH_SPEC` environment variable like
+`MZT_FAULT_SPEC`) call `os._exit` instead: no atexit, no finally, no
+destructors — a genuine whole-process crash.
+
+A plan fires at most once (`fired`); every op after the crash point — e.g.
+from a recovery boot in the same test process — passes through untouched.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+
+from .location import Blob, Consensus
+
+ENV_SPEC = "MZT_CRASH_SPEC"
+# the harness recognizes this exit status as "injected crash", distinct from
+# test failures (1/2), interpreter faults (-11), and clean exits (0)
+CRASH_EXIT_CODE = 86
+
+#: op labels a plan counts (every durable mutation of the substrate)
+OP_LABELS = ("blob.set", "blob.delete", "cas")
+
+
+class CrashPointReached(BaseException):
+    """In-process simulated crash. BaseException so `except Exception`
+    cleanup paths — which a real crash would never run — stay cold."""
+
+    def __init__(self, n: int, label: str, key: str, shape: str):
+        super().__init__(
+            f"injected crash at durable op #{n} ({label} {key!r}, shape={shape})"
+        )
+        self.n = n
+        self.label = label
+        self.key = key
+        self.shape = shape
+
+
+class CrashPlan:
+    """A seeded schedule with (at most) one crash point.
+
+    `crash_at` is the 1-based global durable-op index to crash at; None
+    records the op trace without ever crashing (the matrix's measurement
+    run). `shape` forces a crash shape for targeted tests; the default
+    ("seeded") derives it from `(seed, label, crash_at)`.
+    """
+
+    def __init__(
+        self,
+        seed: int,
+        crash_at: int | None = None,
+        shape: str = "seeded",
+        hard: bool = False,
+        trace_path: str | None = None,
+    ):
+        self.seed = int(seed)
+        self.crash_at = None if crash_at is None else int(crash_at)
+        self.shape = shape
+        self.hard = bool(hard)
+        self.trace_path = trace_path
+        self.fired = False
+        self.op_count = 0
+        self.trace: list = []  # (n, label, key, decision)
+        self._lock = threading.Lock()
+
+    # -- the decision function ------------------------------------------------
+    def shape_at(self, label: str, n: int) -> str:
+        """Crash shape at (label, n): pure in (seed, label, n)."""
+        if self.shape != "seeded":
+            return self.shape
+        r = random.Random(f"{self.seed}|{label}|{n}").random()
+        if label == "blob.set":
+            return "before" if r < 1 / 3 else ("after" if r < 2 / 3 else "torn")
+        return "before" if r < 0.5 else "after"
+
+    def torn_fraction(self, n: int) -> float:
+        """Seeded truncation point for a torn blob.set at op n."""
+        return random.Random(f"{self.seed}|tornfrac|{n}").uniform(0.05, 0.95)
+
+    def _record(self, n: int, label: str, key: str, decision: str) -> None:
+        self.trace.append((n, label, key, decision))
+        if self.trace_path:
+            # open/append/close per op: the very next thing this process does
+            # may be os._exit, and the parent needs every line that happened
+            with open(self.trace_path, "a") as f:
+                f.write(f"{n}\t{label}\t{key}\t{decision}\n")
+
+    def on_op(self, label: str, key: str):
+        """Count one durable op; return its crash shape or None (= run it).
+
+        The caller (wrapper) is responsible for ordering: `before` means do
+        NOT perform the inner op, `after`/`torn` mean perform (or tear) it
+        and then call `crash()`.
+        """
+        with self._lock:
+            self.op_count += 1
+            n = self.op_count
+            if self.fired or self.crash_at is None or n != self.crash_at:
+                self._record(n, label, key, "ok")
+                return None
+            self.fired = True
+            shape = self.shape_at(label, n)
+            if shape == "torn" and label != "blob.set":
+                shape = "after"
+            self._record(n, label, key, f"crash-{shape}")
+            self._crash_ctx = (n, label, key, shape)
+            return shape
+
+    def crash(self) -> None:
+        """Die. Hard plans exit the process; soft plans raise."""
+        n, label, key, shape = self._crash_ctx
+        if self.hard:
+            # no flush dance needed: _record already wrote the trace line
+            os._exit(CRASH_EXIT_CODE)
+        raise CrashPointReached(n, label, key, shape)
+
+    # -- serialization (parent process -> coordinator subprocesses) ----------
+    def to_spec(self) -> str:
+        return json.dumps(
+            {
+                "seed": self.seed,
+                "crash_at": self.crash_at,
+                "shape": self.shape,
+                "hard": self.hard,
+                "trace_path": self.trace_path,
+            }
+        )
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "CrashPlan":
+        d = json.loads(spec)
+        return cls(
+            d["seed"],
+            crash_at=d.get("crash_at"),
+            shape=d.get("shape", "seeded"),
+            hard=d.get("hard", False),
+            trace_path=d.get("trace_path"),
+        )
+
+
+class CrashPointBlob(Blob):
+    """Blob wrapper consulting a CrashPlan at every durable mutation.
+
+    Reads (`get`/`list_keys`/`stat_mtime`) pass through uncounted: a crash
+    interacts with what's on disk, and reads don't change that.
+    """
+
+    def __init__(self, inner: Blob, plan: CrashPlan):
+        self.inner = inner
+        self.plan = plan
+
+    def get(self, key):
+        return self.inner.get(key)
+
+    def set(self, key, value):
+        shape = self.plan.on_op("blob.set", key)
+        if shape is None:
+            return self.inner.set(key, value)
+        if shape == "before":
+            self.plan.crash()
+        if shape == "torn":
+            # the captured crash index, NOT op_count: a concurrent durable
+            # op could bump the counter between on_op and here, and the
+            # truncation must replay identically from (seed, op index)
+            frac = self.plan.torn_fraction(self.plan._crash_ctx[0])
+            cut = max(1, int(len(value) * frac)) if len(value) else 0
+            self.inner.set(key, bytes(value)[:cut])
+            self.plan.crash()
+        self.inner.set(key, value)  # "after": durable, never acked
+        self.plan.crash()
+
+    def delete(self, key):
+        shape = self.plan.on_op("blob.delete", key)
+        if shape is None:
+            return self.inner.delete(key)
+        if shape == "before":
+            self.plan.crash()
+        self.inner.delete(key)
+        self.plan.crash()
+
+    def list_keys(self, prefix=""):
+        return self.inner.list_keys(prefix)
+
+    def stat_mtime(self, key):
+        return self.inner.stat_mtime(key)
+
+
+class CrashPointConsensus(Consensus):
+    def __init__(self, inner: Consensus, plan: CrashPlan):
+        self.inner = inner
+        self.plan = plan
+
+    def head(self, key):
+        return self.inner.head(key)
+
+    def list_keys(self, prefix=""):
+        return self.inner.list_keys(prefix)
+
+    def compare_and_set(self, key, expected_seqno, data):
+        shape = self.plan.on_op("cas", key)
+        if shape is None:
+            return self.inner.compare_and_set(key, expected_seqno, data)
+        if shape == "before":
+            self.plan.crash()
+        self.inner.compare_and_set(key, expected_seqno, data)
+        self.plan.crash()  # "after": the CAS is durable, the ack is lost
+
+
+# -- process-global installation (mirrors cluster/faults.py) ------------------
+_PLAN: CrashPlan | None = None
+
+
+def install(plan: CrashPlan | None) -> None:
+    """Install `plan` as THE process-wide crash schedule (None uninstalls).
+
+    Every Coordinator constructed afterwards wraps its Blob/Consensus in
+    crash-point wrappers sharing this plan (adapter/coordinator.py)."""
+    global _PLAN
+    _PLAN = plan
+
+
+def installed_plan() -> CrashPlan | None:
+    return _PLAN
+
+
+def install_from_env() -> CrashPlan | None:
+    """Subprocess startup: adopt the spawning harness's crash schedule."""
+    spec = os.environ.get(ENV_SPEC)
+    if not spec:
+        return None
+    plan = CrashPlan.from_spec(spec)
+    install(plan)
+    return plan
+
+
+def wrap(blob: Blob, consensus: Consensus, plan: CrashPlan):
+    return CrashPointBlob(blob, plan), CrashPointConsensus(consensus, plan)
+
+
+def wrap_if_installed(blob, consensus):
+    """Coordinator hook: wrap under the installed plan, if any.
+
+    Checks the environment first so `MZT_CRASH_SPEC` subprocesses need no
+    code change — the first Coordinator construction installs the plan.
+    """
+    if _PLAN is None and os.environ.get(ENV_SPEC):
+        install_from_env()
+    if _PLAN is None or blob is None or consensus is None:
+        return blob, consensus
+    if isinstance(blob, CrashPointBlob):  # never double-wrap (re-boots)
+        return blob, consensus
+    return wrap(blob, consensus, _PLAN)

@@ -1,0 +1,258 @@
+"""Offline invariant checker for the durability substrate.
+
+`fsck(blob, consensus)` walks every shard register and blob key and checks
+the invariants the crash-recovery matrix relies on (the single-node analogue
+of persist's state-consistency validation, src/persist-client/src/internal/
+state.rs validate paths):
+
+FATAL (recovery is impossible or would serve wrong answers):
+- a manifest references a blob that does not exist,
+- a referenced blob fails its checksum or does not decode,
+- the durable catalog register is undecodable or written by a NEWER format
+  version than this build supports,
+- a committed txn record's payload is missing while its data shard has not
+  applied it.
+
+REPORTED (suspicious but survivable; `gc()`/maintenance heal most):
+- orphan `batch/` / `txnbatch/` blobs no manifest or txn record references
+  (crash debris between upload and CAS — swept by gc after the grace
+  period),
+- non-monotone frontiers (since ≥ upper on a non-empty shard, a batch
+  interval beyond the shard upper, manifest intervals out of order),
+- a batch whose stored row count disagrees with its payload,
+- txn-wal vs data-shard skew: committed txn records no data shard has
+  applied yet (boot's `apply_up_to` should have drained these).
+
+Exposed as `python -m materialize_tpu fsck --data-dir DIR` and run by the
+crash matrix after every recovery (scripts/crash_matrix.py).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from . import CATALOG_VERSION  # noqa: F401  (re-exported for checkers/tests)
+from .shard import CorruptBlob, ShardState
+from .txn import _unpack_lanes, rec_fields
+
+
+@dataclass
+class Finding:
+    level: str  # "fatal" | "warn" | "info"
+    code: str
+    detail: str
+
+    def as_dict(self) -> dict:
+        return {"level": self.level, "code": self.code, "detail": self.detail}
+
+
+@dataclass
+class FsckReport:
+    findings: list = field(default_factory=list)
+    shards_checked: int = 0
+    batches_checked: int = 0
+
+    def add(self, level: str, code: str, detail: str) -> None:
+        self.findings.append(Finding(level, code, detail))
+
+    @property
+    def fatal(self) -> list:
+        return [f for f in self.findings if f.level == "fatal"]
+
+    @property
+    def ok(self) -> bool:
+        return not self.fatal
+
+    def render(self) -> str:
+        lines = [
+            f"fsck: {self.shards_checked} shards, "
+            f"{self.batches_checked} batches checked"
+        ]
+        for f in self.findings:
+            lines.append(f"  [{f.level.upper():5}] {f.code}: {f.detail}")
+        if not self.findings:
+            lines.append("  no findings")
+        return "\n".join(lines)
+
+
+def _head(consensus, key: str, report: FsckReport):
+    """consensus.head that reports (never raises) on a corrupt register
+    file — the outer JSON wrapper rotting is exactly the corruption an
+    offline checker must diagnose, not traceback on."""
+    try:
+        return consensus.head(key)
+    except Exception as exc:
+        report.add(
+            "fatal", "register-unreadable", f"consensus register {key}: {exc}"
+        )
+        return None
+
+
+def _check_catalog(consensus, report: FsckReport) -> None:
+    head = _head(consensus, "catalog", report)
+    if head is None:
+        return  # a data_dir with no catalog yet is fine
+    import pickle
+
+    try:
+        doc = pickle.loads(head.data)
+    except Exception as exc:
+        report.add("fatal", "catalog-undecodable", f"durable catalog: {exc}")
+        return
+    version = doc.get("version", 1)
+    if version > CATALOG_VERSION:
+        report.add(
+            "fatal",
+            "catalog-version-too-new",
+            f"catalog format v{version} > supported v{CATALOG_VERSION}: "
+            "written by a newer build; this build must not boot it",
+        )
+
+
+def fsck(blob, consensus) -> FsckReport:
+    report = FsckReport()
+    _check_catalog(consensus, report)
+
+    shard_keys = [k for k in consensus.list_keys() if k.startswith("shard/")]
+    referenced: set[str] = set()
+    states: dict[str, ShardState] = {}  # shard_id -> state
+    for key in sorted(shard_keys):
+        sid = key[len("shard/"):]
+        head = _head(consensus, key, report)
+        if head is None:
+            continue  # unreadable (reported) or raced away
+        try:
+            state = states[sid] = ShardState.decode(head.data)
+        except Exception as exc:
+            report.add("fatal", "state-undecodable", f"shard {sid}: {exc}")
+            continue
+        report.shards_checked += 1
+        nonempty = state.upper > 0 or state.batches
+        if nonempty and state.since >= state.upper and state.upper > 0:
+            report.add(
+                "warn",
+                "non-monotone-frontier",
+                f"shard {sid}: since {state.since} >= upper {state.upper} "
+                "(no definite read time remains)",
+            )
+        prev_lower = None
+        for b in state.batches:
+            referenced.add(b.key)
+            if b.lower >= b.upper:
+                report.add(
+                    "warn",
+                    "empty-interval",
+                    f"shard {sid}, batch {b.key}: [{b.lower}, {b.upper})",
+                )
+            if b.upper > state.upper:
+                report.add(
+                    "warn",
+                    "batch-beyond-upper",
+                    f"shard {sid}, batch {b.key}: upper {b.upper} > "
+                    f"shard upper {state.upper}",
+                )
+            if prev_lower is not None and b.lower < prev_lower:
+                report.add(
+                    "warn",
+                    "manifest-disorder",
+                    f"shard {sid}: batch {b.key} lower {b.lower} < "
+                    f"preceding lower {prev_lower}",
+                )
+            prev_lower = b.lower
+            if not b.count:
+                continue
+            report.batches_checked += 1
+            payload = blob.get(b.key)
+            if payload is None:
+                report.add(
+                    "fatal",
+                    "missing-blob",
+                    f"shard {sid}: manifest references missing blob {b.key} "
+                    f"([{b.lower}, {b.upper}), {b.count} rows)",
+                )
+                continue
+            from .shard import decode_columns
+
+            try:
+                cols = decode_columns(
+                    payload, b.checksum, ctx=f"shard {sid}, key {b.key}"
+                )
+            except CorruptBlob as exc:
+                report.add("fatal", "corrupt-blob", str(exc))
+                continue
+            n = int(len(cols.get("times", ())))
+            if n != b.count:
+                report.add(
+                    "warn",
+                    "count-mismatch",
+                    f"shard {sid}, batch {b.key}: manifest says {b.count} "
+                    f"rows, payload holds {n}",
+                )
+
+    # -- txn-wal vs data shards ----------------------------------------------
+    txns = states.get("txns")
+    if txns is not None:
+        for b in txns.batches:
+            if not b.count:
+                continue
+            payload = blob.get(b.key)
+            if payload is None:
+                continue  # already reported fatal above
+            try:
+                from .shard import decode_columns
+
+                cols = decode_columns(payload, b.checksum, ctx=f"txns {b.key}")
+                t = int(cols["times"][0])
+                records = json.loads(_unpack_lanes(cols["recjson"]).decode())
+            except Exception:
+                continue  # corrupt txns batch already reported above
+            for rec in records:
+                shard_id, key, _n, _crc = rec_fields(rec)
+                dstate = states.get(shard_id)
+                applied = dstate is not None and dstate.upper > t
+                if key is not None:
+                    referenced.add(key)
+                    if not applied and blob.get(key) is None:
+                        report.add(
+                            "fatal",
+                            "txn-payload-missing",
+                            f"committed txn at t={t}: payload {key} for "
+                            f"unapplied shard {shard_id} is missing",
+                        )
+                if not applied:
+                    report.add(
+                        "warn",
+                        "txn-skew",
+                        f"txn record at t={t} for shard {shard_id} not yet "
+                        f"applied (shard upper "
+                        f"{dstate.upper if dstate else 'absent'})",
+                    )
+
+    # -- orphans ---------------------------------------------------------------
+    for key in blob.list_keys():
+        if key in referenced:
+            continue
+        if key.startswith("batch/") or key.startswith("txnbatch/"):
+            report.add(
+                "info",
+                "orphan-blob",
+                f"{key}: unreferenced (crash debris pre-CAS; gc sweeps it)",
+            )
+    return report
+
+
+def fsck_data_dir(data_dir: str) -> FsckReport:
+    """fsck a coordinator `data_dir` (the FileBlob/FileConsensus layout).
+
+    Refuses a nonexistent path: the store constructors mkdir their roots,
+    so a typo'd --data-dir would otherwise CREATE an empty tree and report
+    a false green — an offline checker must never mutate what it inspects.
+    """
+    import os
+
+    if not os.path.isdir(data_dir):
+        raise FileNotFoundError(f"data_dir {data_dir!r} does not exist")
+    from .location import FileBlob, FileConsensus
+
+    return fsck(FileBlob(f"{data_dir}/blob"), FileConsensus(f"{data_dir}/consensus"))

@@ -19,6 +19,37 @@ from dataclasses import dataclass
 from typing import Optional
 
 
+# -- the shared local-FS layout mechanics (FileBlob + FileConsensus) ----------
+#: filename prefix of the percent-encoded key scheme; can never collide with
+#: mkstemp scratch ("tmp*") files, and no engine-written key begins with it
+_KEY_PREFIX = "k_"
+
+
+def _encode_key(key: str) -> str:
+    from urllib.parse import quote
+
+    return _KEY_PREFIX + quote(key, safe="")
+
+
+def _decode_key(stem: str) -> Optional[str]:
+    """Key for a new-scheme filename stem; None when stem is legacy-layout."""
+    from urllib.parse import unquote
+
+    if stem.startswith(_KEY_PREFIX):
+        return unquote(stem[len(_KEY_PREFIX):])
+    return None
+
+
+def _fsync_dir(path: str) -> None:
+    """Persist directory entries (renames/unlinks): without this, an acked
+    rename can vanish on power loss even though the file data was fsynced."""
+    fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
 class Blob:
     def get(self, key: str) -> Optional[bytes]:
         raise NotImplementedError
@@ -80,16 +111,12 @@ class FileBlob(Blob):
     exactly for every key.
     """
 
-    _PREFIX = "k_"
-
     def __init__(self, root: str) -> None:
         self.root = root
         os.makedirs(root, exist_ok=True)
 
     def _path(self, key: str) -> str:
-        from urllib.parse import quote
-
-        return os.path.join(self.root, self._PREFIX + quote(key, safe=""))
+        return os.path.join(self.root, _encode_key(key))
 
     def _legacy_path(self, key: str) -> str:
         """Pre-percent-encoding layout ('/' → '__', no prefix): kept as a
@@ -124,11 +151,7 @@ class FileBlob(Blob):
                 f.flush()
                 os.fsync(f.fileno())
             os.replace(tmp, self._path(key))
-            dfd = os.open(self.root, os.O_RDONLY)
-            try:
-                os.fsync(dfd)
-            finally:
-                os.close(dfd)
+            _fsync_dir(self.root)
         except BaseException:
             try:
                 os.unlink(tmp)
@@ -145,20 +168,17 @@ class FileBlob(Blob):
                 # still-existing blob as deleted
 
     def list_keys(self, prefix=""):
-        from urllib.parse import unquote
-
         out = []
         for name in os.listdir(self.root):
-            if name.startswith(self._PREFIX):
-                key = unquote(name[len(self._PREFIX):])
-            elif not name.startswith("tmp"):
+            key = _decode_key(name)
+            if key is None:
+                if name.startswith("tmp"):
+                    continue  # mkstemp scratch files
                 # legacy-layout file: decode with the old (ambiguous) rule so
                 # pre-upgrade blobs stay visible to GC instead of leaking.
                 # Assumes no legacy KEY ever began with "k_" — true for every
                 # key this engine writes ("batch/…", shard gids).
                 key = name.replace("__", "/")
-            else:
-                continue  # mkstemp scratch files
             if key.startswith(prefix):
                 out.append(key)
         return sorted(out)
@@ -182,6 +202,11 @@ class Consensus:
     def head(self, key: str) -> Optional[CasState]:
         raise NotImplementedError
 
+    def list_keys(self, prefix: str = "") -> list[str]:
+        """Every key with a head state (offline enumeration: persist/fsck.py
+        walks all shard registers without knowing their gids up front)."""
+        raise NotImplementedError
+
     def compare_and_set(
         self, key: str, expected_seqno: Optional[int], data: bytes
     ) -> bool:
@@ -202,6 +227,10 @@ class MemConsensus(Consensus):
         with self._lock:
             return self._data.get(key)
 
+    def list_keys(self, prefix=""):
+        with self._lock:
+            return sorted(k for k in self._data if k.startswith(prefix))
+
     def compare_and_set(self, key, expected_seqno, data):
         with self._lock:
             cur = self._data.get(key)
@@ -214,7 +243,16 @@ class MemConsensus(Consensus):
 
 
 class FileConsensus(Consensus):
-    """Single-node durable CAS via atomic rename; seqno embedded in payload."""
+    """Single-node durable CAS via atomic rename; seqno embedded in payload.
+
+    Durability parity with FileBlob: the directory entry is fsynced after
+    `os.replace` — without it, an ACKED compare_and_set could vanish on
+    power loss (payload fsync alone doesn't persist the rename), i.e. a
+    committed shard state or txn-wal commit point silently rolls back.
+    Keys use FileBlob's `k_` percent-encoding (the PR 6 scheme: the old
+    `"/" → "__"` mapping was ambiguous for keys containing a literal `__`),
+    with a read fallback + lazy migration for pre-upgrade layouts.
+    """
 
     def __init__(self, root: str) -> None:
         self.root = root
@@ -222,30 +260,76 @@ class FileConsensus(Consensus):
         self._lock = threading.Lock()
 
     def _path(self, key: str) -> str:
+        return os.path.join(self.root, _encode_key(key) + ".json")
+
+    def _legacy_path(self, key: str) -> str:
+        """Pre-percent-encoding layout ('/' → '__', no prefix): read-only
+        fallback; compare_and_set migrates the register to the new scheme
+        on its next write."""
         return os.path.join(self.root, key.replace("/", "__") + ".json")
 
+    def _read(self, key):
+        for path in (self._path(key), self._legacy_path(key)):
+            try:
+                with open(path, "rb") as f:
+                    doc = json.loads(f.read())
+                return CasState(doc["seqno"], bytes.fromhex(doc["data"]))
+            except FileNotFoundError:
+                continue
+        return None
+
     def head(self, key):
-        try:
-            with open(self._path(key), "rb") as f:
-                doc = json.loads(f.read())
-            return CasState(doc["seqno"], bytes.fromhex(doc["data"]))
-        except FileNotFoundError:
-            return None
+        return self._read(key)
+
+    def list_keys(self, prefix=""):
+        out = set()
+        for name in os.listdir(self.root):
+            if not name.endswith(".json"):
+                continue  # mkstemp scratch files
+            stem = name[: -len(".json")]
+            key = _decode_key(stem)
+            if key is None:
+                # legacy layout (ambiguous rule, same caveat as FileBlob:
+                # no engine-written key ever began with "k_")
+                key = stem.replace("__", "/")
+            if key.startswith(prefix):
+                out.add(key)  # set: a migrated register may exist in both
+        return sorted(out)
 
     def compare_and_set(self, key, expected_seqno, data):
         with self._lock:
-            cur = self.head(key)
+            cur = self._read(key)
             cur_seq = cur.seqno if cur is not None else None
             if cur_seq != expected_seqno:
                 return False
             nxt = 0 if expected_seqno is None else expected_seqno + 1
             doc = json.dumps({"seqno": nxt, "data": bytes(data).hex()}).encode()
             fd, tmp = tempfile.mkstemp(dir=self.root)
-            with os.fdopen(fd, "wb") as f:
-                f.write(doc)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._path(key))
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(doc)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._path(key))
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            _fsync_dir(self.root)
+            legacy = self._legacy_path(key)
+            if legacy != self._path(key):
+                # drop the legacy-layout file only AFTER the rename is
+                # durable (its own dir fsync): unlink-then-crash with an
+                # unpersisted rename would lose the register entirely —
+                # the exact acked-CAS-vanish hazard this class guards
+                try:
+                    os.unlink(legacy)
+                except OSError:
+                    pass
+                else:
+                    _fsync_dir(self.root)
             return True
 
 
@@ -289,6 +373,9 @@ class UnreliableConsensus(Consensus):
         if self.should_fail("head"):
             raise IOError("unreliable consensus: injected failure in head")
         return self.inner.head(key)
+
+    def list_keys(self, prefix=""):
+        return self.inner.list_keys(prefix)
 
     def compare_and_set(self, key, expected_seqno, data):
         if self.should_fail("cas"):

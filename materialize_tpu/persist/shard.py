@@ -15,12 +15,19 @@ from __future__ import annotations
 import io
 import json
 import uuid
+import zlib
 from dataclasses import dataclass, field
 from typing import Optional
 
 import numpy as np
 
 from .location import Blob, Consensus
+
+
+class CorruptBlob(IOError):
+    """A batch payload failed its integrity check (checksum mismatch or
+    undecodable bytes): a torn write or bit rot surfaced loudly, with shard
+    and key context, instead of as a bare np.load decode error."""
 
 
 class UpperMismatch(Exception):
@@ -48,12 +55,14 @@ class Fenced(Exception):
 
 @dataclass
 class HollowBatch:
-    """Manifest entry: payload key + [lower, upper) + row count."""
+    """Manifest entry: payload key + [lower, upper) + row count + payload
+    checksum (crc32 of the encoded bytes; "" for pre-checksum manifests)."""
 
     key: str
     lower: int
     upper: int
     count: int
+    checksum: str = ""
 
 
 @dataclass
@@ -73,7 +82,8 @@ class ShardState:
                 "since": self.since,
                 "upper": self.upper,
                 "batches": [
-                    [b.key, b.lower, b.upper, b.count] for b in self.batches
+                    [b.key, b.lower, b.upper, b.count, b.checksum]
+                    for b in self.batches
                 ],
                 "epoch": self.epoch,
                 "readers": self.readers,
@@ -102,8 +112,32 @@ def encode_columns(cols: dict) -> bytes:
     return buf.getvalue()
 
 
-def decode_columns(data: bytes) -> dict:
-    return dict(np.load(io.BytesIO(data), allow_pickle=False))
+def checksum_bytes(data: bytes) -> str:
+    """Integrity stamp for an encoded batch payload (stored in HollowBatch
+    / txn records); crc32 is plenty against tears and rot, not tampering."""
+    return format(zlib.crc32(data) & 0xFFFFFFFF, "08x")
+
+
+def decode_columns(data: bytes, checksum: str = "", ctx: str = "") -> dict:
+    """Decode a batch payload, verifying `checksum` when the manifest carries
+    one. Any integrity failure raises CorruptBlob with `ctx` (shard/key) so a
+    torn or bit-rotted blob names itself instead of dying inside np.load."""
+    if checksum:
+        actual = checksum_bytes(data)
+        if actual != checksum:
+            raise CorruptBlob(
+                f"corrupt blob{f' ({ctx})' if ctx else ''}: checksum mismatch "
+                f"(manifest {checksum}, payload {actual}, {len(data)} bytes)"
+            )
+    try:
+        return dict(np.load(io.BytesIO(data), allow_pickle=False))
+    except CorruptBlob:
+        raise
+    except Exception as exc:
+        raise CorruptBlob(
+            f"corrupt blob{f' ({ctx})' if ctx else ''}: undecodable payload "
+            f"({len(data)} bytes): {exc}"
+        ) from exc
 
 
 class ShardMachine:
@@ -166,9 +200,12 @@ class ShardMachine:
             raise ValueError(f"upper {upper} must exceed lower {lower}")
         n = int(len(cols["times"])) if "times" in cols else 0
         payload_key = None
+        crc = ""
         if n:
             payload_key = f"batch/{self.shard_id}/{uuid.uuid4().hex}"
-            self.blob.set(payload_key, encode_columns(cols))
+            payload = encode_columns(cols)
+            crc = checksum_bytes(payload)
+            self.blob.set(payload_key, payload)
         try:
             for _ in range(max_retries):
                 seqno, state = self.fetch_state()
@@ -180,7 +217,7 @@ class ShardMachine:
                     since=state.since,
                     upper=upper,
                     batches=list(state.batches)
-                    + ([HollowBatch(payload_key, lower, upper, n)] if n else []),
+                    + ([HollowBatch(payload_key, lower, upper, n, crc)] if n else []),
                     epoch=state.epoch,
                     readers=state.readers,
                 )
@@ -200,6 +237,16 @@ class ShardMachine:
                     pass
             raise
 
+    def fetch_batch(self, b: HollowBatch) -> dict:
+        """Fetch + integrity-check one manifest entry's payload. Missing
+        blobs and checksum/decode failures raise with shard/key context."""
+        payload = self.blob.get(b.key)
+        if payload is None:
+            raise IOError(f"missing blob {b.key} (shard {self.shard_id})")
+        return decode_columns(
+            payload, b.checksum, ctx=f"shard {self.shard_id}, key {b.key}"
+        )
+
     # -- reads ----------------------------------------------------------------
     def snapshot(self, as_of: int) -> list[dict]:
         """All batch payloads at times ≤ as_of (caller advances/consolidates).
@@ -214,10 +261,7 @@ class ShardMachine:
         out = []
         for b in state.batches:
             if b.count and b.lower <= as_of:
-                payload = self.blob.get(b.key)
-                if payload is None:
-                    raise IOError(f"missing blob {b.key}")
-                cols = decode_columns(payload)
+                cols = self.fetch_batch(b)
                 mask = cols["times"] <= np.uint64(as_of)
                 if mask.all():
                     out.append(cols)
@@ -231,8 +275,7 @@ class ShardMachine:
         out = []
         for b in state.batches:
             if b.count and b.upper > frontier:
-                payload = self.blob.get(b.key)
-                cols = decode_columns(payload)
+                cols = self.fetch_batch(b)
                 mask = cols["times"] >= np.uint64(frontier)
                 if mask.any():
                     out.append({k: (v[mask] if not mask.all() else v) for k, v in cols.items()})
@@ -369,7 +412,7 @@ class ShardMachine:
 
         all_cols: dict[str, list] = {}
         for b in mergeable:
-            cols = decode_columns(self.blob.get(b.key))
+            cols = self.fetch_batch(b)
             cols["times"] = advance_times_host(cols["times"], state.since)
             for k, v in cols.items():
                 all_cols.setdefault(k, []).append(v)
@@ -379,13 +422,16 @@ class ShardMachine:
         upper = max(b.upper for b in mergeable)
         n = len(merged["times"])
         new_key = f"batch/{self.shard_id}/{uuid.uuid4().hex}"
+        crc = ""
         if n:
-            self.blob.set(new_key, encode_columns(merged))
+            payload = encode_columns(merged)
+            crc = checksum_bytes(payload)
+            self.blob.set(new_key, payload)
         keep = [b for b in state.batches if not b.count]
         new_state = ShardState(
             since=state.since,
             upper=state.upper,
-            batches=keep + ([HollowBatch(new_key, lower, upper, n)] if n else []),
+            batches=keep + ([HollowBatch(new_key, lower, upper, n, crc)] if n else []),
             epoch=state.epoch,
             readers=state.readers,
         )

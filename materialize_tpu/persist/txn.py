@@ -28,7 +28,13 @@ import uuid
 import numpy as np
 
 from .location import Blob, Consensus
-from .shard import ShardMachine, UpperMismatch, decode_columns, encode_columns
+from .shard import (
+    ShardMachine,
+    UpperMismatch,
+    checksum_bytes,
+    decode_columns,
+    encode_columns,
+)
 
 
 def _pack_lanes(data: bytes) -> np.ndarray:
@@ -38,6 +44,13 @@ def _pack_lanes(data: bytes) -> np.ndarray:
 
 def _unpack_lanes(col: np.ndarray) -> bytes:
     return np.asarray(col, dtype=np.int64).astype("<u8").tobytes().rstrip(b"\x00")
+
+
+def rec_fields(rec) -> tuple:
+    """(shard_id, key, n, checksum) from a txn record; records written
+    before the checksum satellite have 3 fields (checksum = "")."""
+    shard_id, key, n = rec[0], rec[1], rec[2]
+    return shard_id, key, n, (rec[3] if len(rec) > 3 else "")
 
 
 class TxnsMachine:
@@ -84,11 +97,14 @@ class TxnsMachine:
             for shard_id, cols in sorted(writes.items()):
                 n = int(len(cols.get("times", ()))) if cols else 0
                 key = None
+                crc = ""
                 if n:
                     key = f"txnbatch/{shard_id}/{uuid.uuid4().hex}"
-                    self.blob.set(key, encode_columns(cols))
+                    payload = encode_columns(cols)
+                    crc = checksum_bytes(payload)
+                    self.blob.set(key, payload)
                     uploaded.append(key)
-                records.append([shard_id, key, n])
+                records.append([shard_id, key, n, crc])
             lanes = _pack_lanes(json.dumps(records).encode())
             k = len(lanes)
             self.txns.compare_and_append(
@@ -126,7 +142,8 @@ class TxnsMachine:
         applied = 0
         pairs, observed_upper = self._records_below(upper, min_t=self._applied_through)
         for t, records in pairs:
-            for shard_id, key, _n in records:
+            for rec in records:
+                shard_id, key, _n, crc = rec_fields(rec)
                 m = self.data_shard(shard_id)
                 cur = m.upper()
                 if cur > t:
@@ -140,7 +157,9 @@ class TxnsMachine:
                         if self.data_shard(shard_id).upper() > t:
                             continue
                         raise IOError(f"txn-wal: committed payload {key} missing")
-                    cols = decode_columns(payload)
+                    cols = decode_columns(
+                        payload, crc, ctx=f"txn record for {shard_id}, key {key}"
+                    )
                 try:
                     m.compare_and_append(cols, cur, t + 1)
                     applied += 1
@@ -151,7 +170,8 @@ class TxnsMachine:
             # every shard of this record is now confirmed applied (each
             # branch above either applied, found it applied, or raised):
             # reclaim the payloads
-            for _shard_id, key, _n in records:
+            for rec in records:
+                _shard_id, key, _n, _crc = rec_fields(rec)
                 if key is not None:
                     try:
                         self.blob.delete(key)
@@ -190,10 +210,7 @@ class TxnsMachine:
         for b in state.batches:
             if not b.count or b.lower >= upper or b.upper - 1 < min_t:
                 continue
-            payload = self.blob.get(b.key)
-            if payload is None:
-                raise IOError(f"txn-wal: txns batch {b.key} missing")
-            cols = decode_columns(payload)
+            cols = self.txns.fetch_batch(b)
             t = int(cols["times"][0])
             if t >= upper or t < min_t:
                 continue
@@ -218,14 +235,12 @@ class TxnsMachine:
         for b in state.batches:
             if not b.count:
                 continue  # pure upper advancement: no payload to retire
-            payload = self.blob.get(b.key)
-            if payload is None:
-                raise IOError(f"txn-wal: txns batch {b.key} missing")
-            cols = decode_columns(payload)
+            cols = self.txns.fetch_batch(b)
             t = int(cols["times"][0])
             records = json.loads(_unpack_lanes(cols["recjson"]).decode())
             done = True
-            for shard_id, _key, _n in records:
+            for rec in records:
+                shard_id = rec_fields(rec)[0]
                 u = upper_cache.get(shard_id)
                 if u is None:
                     u = upper_cache[shard_id] = self.data_shard(shard_id).upper()
@@ -263,7 +278,8 @@ class TxnsMachine:
 
         referenced = set()
         for _t, records in self._records_below(1 << 62)[0]:
-            for _shard_id, key, _n in records:
+            for rec in records:
+                key = rec_fields(rec)[1]
                 if key is not None:
                     referenced.add(key)
         now = _time.time()

@@ -45,6 +45,7 @@ class FileTailSource:
     spec: FileSourceSpec
     offset: int = 0  # committed byte offset (set from the remap shard)
     decode_errors: int = 0  # malformed lines skipped (dead-letter counter)
+    truncations: int = 0  # times the file was seen SMALLER than the offset
 
     def poll(self, max_records: int = 10_000, max_bytes: int | None = None):
         """(records, new_offset): records are dicts col_name -> raw value
@@ -65,6 +66,15 @@ class FileTailSource:
         try:
             size = os.path.getsize(self.spec.path)
         except FileNotFoundError:
+            return [], self.offset
+        if size < self.offset:
+            # the external file SHRANK below the durable resume offset
+            # (rotation/truncation): the append-only contract is broken.
+            # Re-reading from 0 would double-ingest every record the remap
+            # binding already committed — exactly-once beats liveness here,
+            # so stay put and count it (a restarted engine resuming from the
+            # remap shard surfaces a wedged-with-cause source, not silence).
+            self.truncations += 1
             return [], self.offset
         if size <= self.offset:
             return [], self.offset

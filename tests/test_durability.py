@@ -63,3 +63,66 @@ def test_restart_generator_source_continues(tmp_path):
     # bid ids continue without overlap
     ids = [r[0] for r in rows]
     assert len(set(ids)) == 35
+
+
+def test_quiet_tick_does_not_wedge_mv_reads(tmp_path):
+    """A tick that ingests nothing (file source at EOF, no generators) still
+    advances dataflow frontiers: the oracle's read_ts moved, and an MV peek
+    at read_ts >= frontier would error as incomplete forever (crash-matrix
+    finding)."""
+    import json
+
+    p = tmp_path / "feed.jsonl"
+    p.write_text(json.dumps({"id": 1, "v": 5}) + "\n")
+    c = Coordinator(data_dir=str(tmp_path / "data"))
+    c.execute(
+        f"CREATE SOURCE feed (id int, v int) FROM FILE '{p}' (FORMAT JSON)"
+    )
+    c.execute(
+        "CREATE MATERIALIZED VIEW tot AS SELECT sum(v) AS s FROM feed"
+    )
+    c.advance()  # ingests the one line
+    assert c.execute("SELECT * FROM tot").rows == [(5,)]
+    c.advance()  # quiet: nothing new to ingest
+    c.advance()  # and again
+    assert c.execute("SELECT * FROM tot").rows == [(5,)]
+
+
+def test_restart_heals_diverged_mv_shard(tmp_path):
+    """Boot reconciliation: if the MV's durable shard is missing a delta
+    (crash between base commit and derived persist), restart appends one
+    correction so external shard readers converge with the recomputed
+    view."""
+    import numpy as np
+
+    d = str(tmp_path / "data")
+    c1 = Coordinator(data_dir=d)
+    c1.execute("CREATE TABLE t (g int, v int)")
+    c1.execute("INSERT INTO t VALUES (1, 10), (2, 20)")
+    c1.execute(
+        "CREATE MATERIALIZED VIEW mv AS SELECT g, sum(v) AS s FROM t GROUP BY g"
+    )
+    gid = c1.catalog.get("mv").global_id
+    # simulate the lost derived persist: rewind the MV shard's manifest by
+    # dropping its last batch (keeping upper), as a crash-before would
+    m = c1._shard(gid)
+    seqno, state = m.fetch_state()
+    from materialize_tpu.persist import ShardState
+
+    assert state.batches, "MV hydration should have been persisted"
+    broken = ShardState(
+        since=state.since, upper=state.upper, batches=[],
+        epoch=state.epoch, readers=state.readers,
+    )
+    assert m.consensus.compare_and_set(m._key, seqno, broken.encode())
+    c2 = Coordinator(data_dir=d)
+    m2 = c2._shard(gid)
+    _seq2, state2 = m2.fetch_state()
+    assert state2.batches, "boot reconciliation must heal the durable shard"
+    total = {}
+    for cols_ in m2.snapshot(state2.upper - 1):
+        for g, s, diff in zip(cols_["c0"], cols_["c1"], cols_["diffs"]):
+            total[(int(g), int(s))] = total.get((int(g), int(s)), 0) + int(diff)
+    assert {k: v for k, v in total.items() if v} == {(1, 10): 1, (2, 20): 1}
+    # and the logical view still reads correctly
+    assert c2.execute("SELECT * FROM mv ORDER BY g").rows == [(1, 10), (2, 20)]

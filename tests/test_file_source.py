@@ -187,3 +187,20 @@ def test_upsert_requires_valid_key(tmp_path):
         )
     # the failed statements left no catalog debris
     assert ("s",) not in c.execute("SHOW SOURCES").rows
+
+
+def test_truncated_file_does_not_reingest(tmp_path):
+    """An externally truncated file (append-only contract broken) must not
+    re-ingest from offset 0 — the remap binding already committed those
+    offsets; the source stays put and counts the truncation."""
+    p = tmp_path / "feed.jsonl"
+    p.write_text(json.dumps({"id": 1}) + "\n" + json.dumps({"id": 2}) + "\n")
+    c = Coordinator()
+    c.execute(f"CREATE SOURCE feed (id int) FROM FILE '{p}' (FORMAT JSON)")
+    c.advance()
+    assert c.execute("SELECT count(*) FROM feed").rows == [(2,)]
+    p.write_text(json.dumps({"id": 9}) + "\n")  # shorter than the offset
+    c.advance()
+    assert c.execute("SELECT count(*) FROM feed").rows == [(2,)]
+    src, _gid, _u = c.file_sources[0]
+    assert src.truncations >= 1

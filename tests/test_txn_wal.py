@@ -15,6 +15,7 @@ from materialize_tpu.persist import (
     UnreliableConsensus,
     UpperMismatch,
 )
+from materialize_tpu.persist.txn import rec_fields
 
 
 def cols(data, times, diffs):
@@ -113,7 +114,7 @@ def test_partial_apply_crash_is_idempotent():
         # apply shard 'a' then crash
         recs, _upper = self._records_below(upper)
         for t, records in recs:
-            for shard_id, key, _n in sorted(records):
+            for shard_id, key, _n, _crc in map(rec_fields, sorted(records)):
                 m = self.data_shard(shard_id)
                 if m.upper() > t:
                     continue
