@@ -174,6 +174,13 @@ class Coordinator:
         # pgwire cancellation registry: backend pid -> (secret key, session);
         # a CancelRequest must present the exact secret or it is a no-op
         self.cancel_keys: dict[int, tuple] = {}
+        # cross-dataflow arrangement sharing (arrangement/trace_manager.py):
+        # dataflows reading the same collection share one arrangement per
+        # (collection, key) with reader-held compaction; the dyncfg
+        # enable_arrangement_sharing force-disables for bisection
+        from ..arrangement.trace_manager import TraceManager
+
+        self.trace_manager = TraceManager()
         self.blob = blob
         self.consensus = consensus
         if data_dir is not None:
@@ -643,6 +650,19 @@ class Coordinator:
         item = self.catalog.create(
             CatalogItem(stmt.name, "materialized_view", desc=pq.desc, query_ast=stmt.query)
         )
+        try:
+            return self._install_mv(item, pq, rel)
+        except Exception:
+            # install is transactional against the shared-trace registry and
+            # in-memory state: a CREATE that fails after exporting a trace
+            # must not leak the export (a later dataflow would import a
+            # stale, reader-less trace). CrashPointReached is a
+            # BaseException and deliberately skips this — crash recovery
+            # converges via boot, not via in-process cleanup.
+            self._rollback_mv_install(item)
+            raise
+
+    def _install_mv(self, item: CatalogItem, pq, rel) -> ExecResult:
         gid = item.global_id
         src_gids = sorted(_collect_gets(rel))
         env = {g: self.storage[g].dtypes for g in src_gids}
@@ -651,8 +671,9 @@ class Coordinator:
         )
         # hydrate: snapshot all inputs at the current read timestamp
         as_of = self.oracle.read_ts()
+        desc.as_of = as_of
         snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
-        df = self._make_dataflow(desc, snaps)
+        df = self._make_dataflow(desc, snaps, trace_reader=gid)
         results = df.step(as_of, snaps)
         self.storage[gid] = StorageCollection(pq.desc.dtypes)
         out = results.get(gid)
@@ -677,6 +698,25 @@ class Coordinator:
             self._persist_batches({gid: out[0]}, as_of)
         return ExecResult("status", status="CREATE MATERIALIZED VIEW")
 
+    def _rollback_mv_install(self, item: CatalogItem) -> None:
+        """Undo a failed CREATE MATERIALIZED VIEW: in-memory state, the
+        dataflow, and — crucially — any shared-trace exports/holds the
+        render registered, leaving the TraceManager exactly as before."""
+        gid = item.global_id
+        self.catalog.items.pop(item.name, None)
+        self.storage.pop(gid, None)
+        self.dataflows = [d for d in self.dataflows if d[0] != gid]
+        self.trace_manager.rollback_install(gid)
+        if self.durable and self.deploy_state == "leader":
+            try:
+                # scrub the item from the durable catalog if the install got
+                # far enough to persist it; best-effort — a boot that still
+                # sees the item just reinstalls the MV, which is the
+                # pre-rollback contract for partial CREATEs
+                self._persist_catalog()
+            except Exception:
+                pass
+
     def _create_index(self, stmt: ast.CreateIndex) -> ExecResult:
         on = self.catalog.get(stmt.on)
         key = tuple(on.desc.index_of(c) for c in stmt.key_columns) if stmt.key_columns else tuple(on.desc.key)
@@ -692,6 +732,10 @@ class Coordinator:
         if item is not None:
             self.storage.pop(item.global_id, None)
             self.dataflows = [d for d in self.dataflows if d[0] != item.global_id]
+            # release the dropped dataflow's since holds: shared traces it
+            # read re-arm compaction to the next-slowest reader, and a trace
+            # left with NO readers is deleted (nobody would maintain it)
+            self.trace_manager.release(item.global_id)
             if hasattr(self, "file_sources"):
                 self.file_sources = [
                     e for e in self.file_sources if e[1] != item.global_id
@@ -761,15 +805,27 @@ class Coordinator:
         self._apply_writes({item.global_id: batch}, ts)
         return ExecResult("status", status=f"DELETE {n}")
 
-    def _make_dataflow(self, desc, snaps: dict | None = None):
+    def _traces(self):
+        """The shared-trace registry, or None when arrangement sharing is
+        force-disabled (enable_arrangement_sharing, the bisection dyncfg)."""
+        if not bool(self.configs.get("enable_arrangement_sharing")):
+            return None
+        return self.trace_manager
+
+    def _make_dataflow(self, desc, snaps: dict | None = None, trace_reader=None):
         """Render a DataflowDescription: the fused single-program path when
         enabled and expressible, else the host-orchestrated operator graph
         (the rendering-choice analogue of ENABLE_MZ_JOIN_CORE)."""
+        traces = self._traces() if trace_reader is not None else None
         if bool(self.configs.get("enable_fused_render")):
-            from ..dataflow.fused import FusedDataflow, FusedUnsupported
+            from ..dataflow.fused import FusedCaps, FusedDataflow, FusedUnsupported
 
+            caps = FusedCaps(
+                ratio=int(self.configs.get("lsm_merge_ratio")),
+                cap_ratio=int(self.configs.get("fused_join_cap_ratio")),
+            )
             try:
-                df = FusedDataflow(desc, mesh=self.mesh)
+                df = FusedDataflow(desc, caps=caps, mesh=self.mesh, traces=traces)
                 if snaps:
                     # pre-size so the hydration tick doesn't ladder through
                     # doubling retries on large input snapshots
@@ -779,7 +835,7 @@ class Coordinator:
                 return df
             except FusedUnsupported:
                 pass
-        return Dataflow(desc)
+        return Dataflow(desc, traces=traces, trace_reader=trace_reader)
 
     def _encode_val(self, v, cd):
         """Re-encode a decoded row value to its storage representation:
@@ -1129,8 +1185,9 @@ class Coordinator:
             gid, rel, env, src_gids, index_key=(), as_of=0, mono_ids=self._mono_ids()
         )
         as_of = self.oracle.read_ts()
+        desc.as_of = as_of
         snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
-        df = self._make_dataflow(desc, snaps)
+        df = self._make_dataflow(desc, snaps, trace_reader=gid)
         results = df.step(as_of, snaps)
         out = results.get(gid)
         if out is not None and out[0] is not None:
@@ -1829,13 +1886,32 @@ class Coordinator:
                 "peek", rel, env, src_gids, as_of=as_of, mono_ids=self._mono_ids(),
                 until=as_of + 1,
             )
-            df = Dataflow(desc)
-            # the ephemeral dataflow is cancel-safe: no shared state to tear,
-            # so the tick loop checks the deadline between every dispatch
-            df.cancel_check = self.check_cancellation
-            snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
-            df.step(as_of, snaps)
-            rows = df.peek("idx_peek", byte_budget=self._result_budget())
+            # ephemeral peeks IMPORT shared traces (export=False: a trace
+            # exported by a one-tick dataflow would instantly go stale) and
+            # hold them at as_of for the peek's lifetime; get_arrangement
+            # validates as_of against each shared since — a trace compacted
+            # past as_of is skipped so the peek renders privately from
+            # snapshots instead of reading a partial history
+            tm = self._traces()
+            peek_reader = None
+            if tm is not None:
+                self._peek_seq = getattr(self, "_peek_seq", 0) + 1
+                peek_reader = f"_peek_{self._peek_seq}"
+            try:
+                df = Dataflow(
+                    desc, traces=tm, trace_reader=peek_reader, trace_export=False
+                )
+                # the ephemeral dataflow is cancel-safe: no shared state to
+                # tear, so the tick loop checks the deadline between every
+                # dispatch
+                df.cancel_check = self.check_cancellation
+                snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
+                df.step(as_of, snaps)
+                rows = df.peek("idx_peek", byte_budget=self._result_budget())
+            finally:
+                if tm is not None:
+                    # the peek expiring releases its holds (compaction re-arms)
+                    tm.release(peek_reader)
         rows = self._finish(rows, pq)
         self._record_peek(_time.perf_counter_ns() - t0)
         return ExecResult("rows", rows=rows, columns=tuple(c.name for c in pq.scope.cols))

@@ -96,6 +96,24 @@ LOG_FILTER = Config(
     "log_filter", "off", "tracing emission level: off | info | debug "
     "(the ALTER SYSTEM SET log_filter analogue, doc/developer/tracing.md)"
 )
+ARRANGEMENT_SHARING = Config(
+    "enable_arrangement_sharing",
+    True,
+    "share one arrangement per (collection, key columns) across every "
+    "dataflow that reads it (arrangement/trace_manager.py: import handles + "
+    "reader-held since holds) instead of arranging per-MV; force-disable "
+    "for bisection — affects dataflows rendered AFTER the change",
+)
+FUSED_JOIN_CAP_RATIO = Config(
+    "fused_join_cap_ratio",
+    4,
+    "geometric taper of per-LSM-level join output caps in the fused "
+    "renderer: level i gets join_out/ratio^(levels-1-i) slots (floored at "
+    "the probe width) instead of a uniform join_out per level — shrinks the "
+    "concat the canonicalizing sort runs over in big-tick regimes "
+    "(1 = uniform, the pre-PR-9 behavior); overflow-retry keeps any "
+    "setting lossless",
+)
 FUSED_RENDER = Config(
     "enable_fused_render",
     False,
@@ -200,6 +218,8 @@ ALL_CONFIGS = [
     ENABLE_DELTA_JOIN,
     DELTA_JOIN_MAX_INPUTS,
     LSM_MERGE_RATIO,
+    ARRANGEMENT_SHARING,
+    FUSED_JOIN_CAP_RATIO,
     INDEX_FAST_PATH,
     INTROSPECTION,
     LOG_FILTER,

@@ -60,6 +60,15 @@ INTROSPECTION_TABLES = {
         ("name", ColType.STRING),
         ("value", ColType.INT64),
     ),
+    "mz_arrangement_sharing": _desc(
+        ("trace_key", ColType.STRING),
+        ("exporter", ColType.STRING),
+        ("readers", ColType.INT64),
+        ("since_hold", ColType.INT64),
+        ("batches", ColType.INT64),
+        ("capacity", ColType.INT64),
+        ("records", ColType.INT64),
+    ),
     "mz_arrangement_sizes": _desc(
         ("dataflow", ColType.STRING),
         ("operator_id", ColType.INT64),
@@ -131,6 +140,12 @@ def introspection_rows(coord, name: str) -> list[tuple]:
         counts["statement_queue_depth"] = coord.admission.depth
         counts["peek_queue_depth"] = coord.peek_gate.depth
         return sorted(counts.items())
+    if name == "mz_arrangement_sharing":
+        # one row per shared trace (arrangement/trace_manager.py): who
+        # exported it, how many readers hold it, and the current minimum
+        # since hold — the sharing win (and the compaction laggard) is
+        # queryable without a profiler
+        return coord.trace_manager.sharing_rows()
     if name == "mz_arrangement_sizes":
         out = []
         for gid, df, _src in coord.dataflows:

@@ -1,3 +1,10 @@
 from .spine import Arrangement, arrange_batch
+from .trace_manager import SharedReduceTrace, SharedTrace, TraceManager
 
-__all__ = ["Arrangement", "arrange_batch"]
+__all__ = [
+    "Arrangement",
+    "arrange_batch",
+    "SharedReduceTrace",
+    "SharedTrace",
+    "TraceManager",
+]

@@ -50,17 +50,53 @@ def arrange_batch(
 
 @dataclass
 class Arrangement:
-    """Host handle to spine state. `key_cols` indexes into the row (val) columns."""
+    """Host handle to spine state. `key_cols` indexes into the row (val) columns.
+
+    `holds` is the reader-held compaction ledger (the persist leased-reader
+    shape, host-side): a shared arrangement may be probed by several
+    dataflows, and `allow_compaction` only advances `since` to the minimum
+    over live holds — releasing a hold (DROP of a reader) re-arms compaction
+    up to the next-slowest reader. Private arrangements never register holds
+    and keep the plain `compact` path.
+    """
 
     key_cols: tuple[int, ...]
     batches: list[UpdateBatch] = field(default_factory=list)
     since: int = 0  # logical compaction frontier
+    holds: dict = field(default_factory=dict)  # reader id -> held since
 
     def insert(self, delta: UpdateBatch, already_keyed: bool = False) -> None:
         """Add a delta batch (raw, keyed on the fly) and restore the merge invariant."""
         b = delta if already_keyed else arrange_batch(delta, self.key_cols)
         self.batches.append(b)
         self._maintain()
+
+    # -- reader-held compaction (shared-trace protocol) ---------------------
+    def hold(self, reader: str, since: int) -> None:
+        """Register (or re-pin) `reader`'s since hold; compaction can never
+        advance past the minimum live hold while the reader is registered."""
+        self.holds[reader] = int(since)
+
+    def downgrade_hold(self, reader: str, since: int) -> None:
+        """Advance one reader's hold (holds only ever move forward)."""
+        if reader in self.holds:
+            self.holds[reader] = max(self.holds[reader], int(since))
+
+    def release_hold(self, reader: str) -> None:
+        """Drop a reader's hold and re-arm compaction to the remaining
+        minimum (the DROP-releases-hold half of the sharing protocol).
+        A reader with no hold here is a no-op — it must not advance since
+        on an arrangement it never read."""
+        if self.holds.pop(reader, None) is None:
+            return
+        if self.holds:
+            self.compact(min(self.holds.values()))
+
+    def allow_compaction(self, since: int) -> None:
+        """Advance `since`, but never past the minimum live reader hold."""
+        if self.holds:
+            since = min(since, min(self.holds.values()))
+        self.compact(since)
 
     def _maintain(self) -> None:
         # Merge while the tail batch is at least half the size of its

@@ -32,6 +32,7 @@ import threading
 
 import numpy as np
 
+from ..arrangement.trace_manager import TraceManager
 from ..dataflow import Dataflow
 from ..dataflow.runtime import ShardContext
 from ..persist import FileBlob, FileConsensus, ShardMachine
@@ -53,6 +54,13 @@ class ShardWorker:
         self.mesh = mesh
         self.state = state
         self.dataflows: dict[str, dict] = {}
+        # per-(worker, shard) shared-trace registry: dataflows rendered on
+        # this worker share one arrangement per (collection, key) holding
+        # this worker's partition. Created fresh at FormMesh (state.epoch is
+        # already the bumped epoch), so reform drops every trace and hold;
+        # the controller's command-history replay reinstalls the dataflows,
+        # which re-export the traces and re-register every hold.
+        self.traces = TraceManager(epoch=state.epoch)
         self.jobs: queue.Queue = queue.Queue()
         self.thread = threading.Thread(target=self._run, daemon=True)
         self.thread.start()
@@ -113,6 +121,9 @@ class ClusterState:
         self.epoch = -1
         # dataflow_id -> dict(df, source_shards, frontier)  (whole-replica mode)
         self.dataflows: dict[str, dict] = {}
+        # whole-replica shared-trace registry (sharded mode keeps one per
+        # ShardWorker instead: traces hold per-worker partitions)
+        self.traces = TraceManager()
         # sharded mode (set by FormMesh)
         self.mesh: WorkerMesh | None = None
         self.workers: list[ShardWorker] = []
@@ -202,6 +213,9 @@ class ClusterState:
         self.workers = []
         self.dataflows.clear()
         self.sharded_dataflows.clear()
+        # shared traces die with the dataflows that held them: the replay
+        # that rebuilds state at the bumped epoch rebuilds every hold too
+        self.traces = TraceManager(epoch=cmd.epoch)
         try:
             self.mesh.form(
                 cmd.epoch,
@@ -226,25 +240,40 @@ class ClusterState:
         if cmd.dataflow_id in self.dataflows:
             # reconciliation replay: already installed, keep as-is
             return p.Frontiers(self._uppers())
-        df = Dataflow(cmd.desc)
+        # the handle's hydration frame (TraceHandle.as_of) keys off desc.as_of
+        cmd.desc.as_of = cmd.as_of
+        try:
+            df = Dataflow(
+                cmd.desc, traces=self.traces, trace_reader=cmd.dataflow_id
+            )
+        except Exception:
+            self.traces.rollback_install(cmd.dataflow_id)
+            raise
         st = {
             "df": df,
             "source_shards": dict(cmd.source_shards),
             "frontier": cmd.as_of,
         }
         self.dataflows[cmd.dataflow_id] = st
-        # hydrate from shard snapshots at as_of
-        snaps = {}
-        for gid, shard_id in st["source_shards"].items():
-            m = ShardMachine(self.blob, self.consensus, shard_id)
-            _seq, state = m.fetch_state()
-            if state.batches:
-                at = max(min(cmd.as_of, state.upper - 1), state.since)
-                batches = m.snapshot(at)
-                if batches:
-                    snaps[gid] = _cols_to_batch(batches, cmd.as_of)
-        if snaps:
-            df.step(cmd.as_of, snaps)
+        try:
+            # hydrate from shard snapshots at as_of
+            snaps = {}
+            for gid, shard_id in st["source_shards"].items():
+                m = ShardMachine(self.blob, self.consensus, shard_id)
+                _seq, state = m.fetch_state()
+                if state.batches:
+                    at = max(min(cmd.as_of, state.upper - 1), state.since)
+                    batches = m.snapshot(at)
+                    if batches:
+                        snaps[gid] = _cols_to_batch(batches, cmd.as_of)
+            if snaps:
+                df.step(cmd.as_of, snaps)
+        except Exception:
+            # a failed install must not leak its trace exports/holds (or a
+            # half-installed dataflow) to the next CreateDataflow replay
+            self.dataflows.pop(cmd.dataflow_id, None)
+            self.traces.rollback_install(cmd.dataflow_id)
+            raise
         st["frontier"] = cmd.as_of + 1
         df.frontier = cmd.as_of + 1
         return p.Frontiers(self._uppers())
@@ -266,11 +295,18 @@ class ClusterState:
                         _partition_source(c, n_workers) for c in batches
                     ]
 
+        cmd.desc.as_of = cmd.as_of
+
         def create(w: ShardWorker):
             shard_ctx = ShardContext(
                 self.mesh, cmd.dataflow_id, w.global_index, n_workers
             )
-            df = Dataflow(cmd.desc, shard=shard_ctx)
+            df = Dataflow(
+                cmd.desc,
+                shard=shard_ctx,
+                traces=w.traces,
+                trace_reader=cmd.dataflow_id,
+            )
             snaps = {}
             for gid, batch_parts in snaps_parts.items():
                 parts = [
@@ -292,8 +328,10 @@ class ClusterState:
         except MeshError as e:
             # a MeshError is retryable by reform; the controller keys on the
             # prefix to drive heal+reform instead of surfacing a hard error
+            self._rollback_sharded_create(cmd.dataflow_id)
             return p.CommandErr(f"MeshError: sharded create_dataflow: {e}")
         except Exception as e:
+            self._rollback_sharded_create(cmd.dataflow_id)
             return p.CommandErr(f"sharded create_dataflow failed: {e}")
         self.sharded_dataflows[cmd.dataflow_id] = {
             "desc": cmd.desc,
@@ -303,17 +341,27 @@ class ClusterState:
         }
         return p.Frontiers(self._uppers())
 
+    def _rollback_sharded_create(self, dataflow_id: str) -> None:
+        """Scrub a failed sharded install from every worker: the partially
+        rendered Dataflows AND any shared-trace exports/holds they
+        registered (a leaked export would feed later imports a trace nobody
+        steps). Safe from the handler thread — _run_on_workers has already
+        joined every worker's job."""
+        for w in self.workers:
+            w.dataflows.pop(dataflow_id, None)
+            w.traces.rollback_install(dataflow_id)
+
     def _process_to(self, upper: int):
         """Pull new shard data and step dataflows tick by tick (the worker
         loop: server.rs:356 analogue, driven by explicit ProcessTo)."""
         if self.sharded:
             return self._process_to_sharded(upper)
+        # collect per-dataflow per-source updates in [frontier, upper) first…
+        per_df: dict[str, dict[int, dict[str, list]]] = {}
         for df_id, st in self.dataflows.items():
-            df = st["df"]
             lo = st["frontier"]
             if upper <= lo:
                 continue
-            # collect per-source updates in [lo, upper)
             per_time: dict[int, dict[str, list]] = {}
             for gid, shard_id in st["source_shards"].items():
                 m = ShardMachine(self.blob, self.consensus, shard_id)
@@ -328,14 +376,25 @@ class ClusterState:
                         per_time.setdefault(int(t), {}).setdefault(gid, []).append(
                             {k: v[tmask] for k, v in sub.items()}
                         )
-            for t in sorted(per_time):
+            per_df[df_id] = per_time
+        # …then step TICK-major across dataflows: shared traces require that
+        # no reader advances past tick t before every reader with data at t
+        # has stepped it (a df-major sweep would let the first dataflow drive
+        # a shared trace to upper while a later reader still reads at lo).
+        # A dataflow quiet at t never reads at t, so skipping it is safe.
+        for t in sorted({t for pt in per_df.values() for t in pt}):
+            for df_id, per_time in per_df.items():
+                if t not in per_time:
+                    continue
                 deltas = {
                     gid: _cols_to_batch(parts, None)
                     for gid, parts in per_time[t].items()
                 }
-                df.step(t, deltas)
+                self.dataflows[df_id]["df"].step(t, deltas)
+        for df_id in per_df:
+            st = self.dataflows[df_id]
             st["frontier"] = upper
-            df.frontier = upper
+            st["df"].frontier = upper
         return p.Frontiers(self._uppers())
 
     def _process_to_sharded(self, upper: int):
@@ -344,11 +403,13 @@ class ClusterState:
         the tick sequence must be identical mesh-wide even where a worker
         (or the whole replica) has no local data for a tick."""
         n_workers = self.mesh.n_workers
+        # read + partition the shard listens once per process, for EVERY
+        # pending dataflow, before any tick runs
+        pending: list[tuple] = []  # (df_id, lo, {gid: [per-batch parts]})
         for df_id, st in self.sharded_dataflows.items():
             lo = st["frontier"]
             if upper <= lo:
                 continue
-            # read + partition the shard listens once per process
             per_source: dict[str, list] = {}  # gid -> [per-batch parts lists]
             for gid, shard_id in st["source_shards"].items():
                 m = ShardMachine(self.blob, self.consensus, shard_id)
@@ -361,10 +422,20 @@ class ClusterState:
                         subs.append(_partition_source(sub, n_workers))
                 if subs:
                     per_source[gid] = subs
+            pending.append((df_id, lo, per_source))
+        if not pending:
+            return p.Frontiers(self._uppers())
 
-            def advance(w: ShardWorker, df_id=df_id, per_source=per_source):
-                wst = w.dataflows[df_id]
-                df = wst["df"]
+        def advance(w: ShardWorker):
+            # Tick-major across dataflows (every dataflow still steps EVERY
+            # tick in its [lo, upper) — the exchanges are how peers learn a
+            # timestamp is closed): shared traces on this worker require no
+            # reader to advance past tick t before the others step it. The
+            # per-tick dataflow order is the sharded_dataflows insertion
+            # order, identical mesh-wide (same command history), so exchange
+            # barriers line up across workers.
+            plans = []
+            for df_id, lo, per_source in pending:
                 per_time: dict[int, dict[str, list]] = {}
                 for gid, subs in per_source.items():
                     for parts in subs:
@@ -376,23 +447,29 @@ class ClusterState:
                             per_time.setdefault(int(t), {}).setdefault(
                                 gid, []
                             ).append({k: v[tmask] for k, v in part.items()})
-                for t in range(lo, upper):
+                plans.append((df_id, lo, per_time))
+            for t in range(min(lo for _, lo, _ in plans), upper):
+                for df_id, lo, per_time in plans:
+                    if t < lo:
+                        continue
                     deltas = {
                         gid: _cols_to_batch(parts, None)
                         for gid, parts in per_time.get(t, {}).items()
                     }
-                    df.step(t, deltas)
-                wst["frontier"] = upper
-                df.frontier = upper
-                return None
+                    w.dataflows[df_id]["df"].step(t, deltas)
+            for df_id, _lo, _pt in plans:
+                w.dataflows[df_id]["frontier"] = upper
+                w.dataflows[df_id]["df"].frontier = upper
+            return None
 
-            try:
-                _run_on_workers(self.workers, advance)
-            except MeshError as e:
-                return p.CommandErr(f"MeshError: sharded process_to: {e}")
-            except Exception as e:
-                return p.CommandErr(f"sharded process_to failed: {e}")
-            st["frontier"] = upper
+        try:
+            _run_on_workers(self.workers, advance)
+        except MeshError as e:
+            return p.CommandErr(f"MeshError: sharded process_to: {e}")
+        except Exception as e:
+            return p.CommandErr(f"sharded process_to failed: {e}")
+        for df_id, _lo, _ps in pending:
+            self.sharded_dataflows[df_id]["frontier"] = upper
         return p.Frontiers(self._uppers())
 
     def _peek(self, cmd: p.Peek):

@@ -84,11 +84,12 @@ class FusedCaps:
     delta: int = 1 << 10  # per-source per-tick delta rows
     arrangement: int = 1 << 14  # top LSM level per join/topk arrangement
     groups: int = 1 << 13  # top accumulator-table level per reduce
-    join_out: int = 1 << 12  # join output rows per level pair
+    join_out: int = 1 << 12  # join output cap (largest level; see join_caps)
     gather: int = 1 << 12  # topk gathered group contents per level
     bucket: int = 0  # exchange bucket per destination (0 = delta)
     levels: int = 3
-    ratio: int = 8
+    ratio: int = 8  # LSM merge-schedule ratio (lsm_merge_ratio dyncfg)
+    cap_ratio: int = 4  # per-level join-output taper (fused_join_cap_ratio)
 
     def scaled(self, k: int) -> "FusedCaps":
         return FusedCaps(
@@ -100,12 +101,40 @@ class FusedCaps:
             bucket=self.bucket * k,
             levels=self.levels,
             ratio=self.ratio,
+            cap_ratio=self.cap_ratio,
         )
 
     def arr_levels(self, full: int) -> tuple:
         from ..models.fused_q3 import level_caps
 
-        return level_caps(full, max(self.delta, 64), self.levels)
+        return level_caps(full, max(self.delta, 64), self.levels, ratio=self.ratio)
+
+    def join_caps(self, probe_cap: int, arr_caps) -> tuple:
+        """Per-LEVEL join output caps (the PROFILE_r5 §4 big-tick lever).
+
+        A uniform (join_out,) × levels cap pays K × join_out concat/sort
+        width per probe even though the small levels hold a ratio^k-th of
+        the arrangement. Level i (small → large) gets
+        join_out / cap_ratio^(levels-1-i), floored at the probe width (a
+        fresh delta can match mostly-new rows sitting in level 0) and capped
+        by the PROVABLE pair bound probe.cap × level.cap where that is
+        tighter. cap_ratio=1 restores the uniform caps. Any taper stays
+        lossless: a level whose matches exceed its cap trips the overflow
+        retry like every other capacity in this file.
+        """
+        if hasattr(arr_caps, "levels"):
+            arr_caps = tuple(b.cap for b in arr_caps.levels)
+        n = len(arr_caps)
+        ratio = max(int(self.cap_ratio), 1)  # dyncfg is unchecked; 0 would divide
+        out = []
+        for i, c in enumerate(arr_caps):
+            cap = max(
+                self.join_out // (ratio ** (n - 1 - i)),
+                bucket_cap(probe_cap),
+            )
+            cap = min(cap, self.join_out, bucket_cap(probe_cap * c))
+            out.append(max(cap, 8))
+        return tuple(out)
 
 
 # ---------------------------------------------------------------------------
@@ -402,7 +431,13 @@ class FusedCompiler:
         overflow stays loud — compact_to flags live > 2×out_cap, and the
         final shrink checks the post-consolidation count exactly like the
         pre-compaction path did (a tripped flag aborts the tick; the host
-        retries with doubled caps)."""
+        retries with doubled caps).
+
+        With per-level join caps (FusedCaps.join_caps), the concat's total
+        capacity is often PROVABLY below 2×out_cap already (sum of the
+        tapered per-level caps bounds the live rows) — the `acc.cap >
+        mid_cap` guard then skips the blanket 2× compaction pass outright
+        and the canonicalizing sort runs at the tighter bound."""
         acc = outs[0]
         for p in outs[1:]:
             acc = UpdateBatch.concat(acc, p)
@@ -432,7 +467,6 @@ class FusedCompiler:
 
     def _emit_join(self, e: lir.Join, ctx: _Ctx) -> UpdateBatch:
         caps = self.caps
-        jcaps = (caps.join_out,) * caps.levels
         kind, slots = self._emitters[id(e)]
         deltas = [self._emit(i, ctx) for i in e.inputs]
         if kind == "linear_join":
@@ -445,8 +479,8 @@ class FusedCompiler:
                 drk = self._exchanged(
                     arrange_batch(deltas[si + 1], st.lookup_key), ctx
                 )
-                outs, f1 = lsm_join(dlk, R, jcaps)
-                outs2, f2 = lsm_join(drk, L, jcaps, swap=True)
+                outs, f1 = lsm_join(dlk, R, caps.join_caps(dlk.cap, R))
+                outs2, f2 = lsm_join(drk, L, caps.join_caps(drk.cap, L), swap=True)
                 dd = join_materialize(dlk, drk, caps.join_out)
                 fdd = join_total(dlk, drk) > caps.join_out
                 ctx.overflow.extend([f1, f2, fdd])
@@ -472,7 +506,7 @@ class FusedCompiler:
                         arrange_batch(stream, st.stream_key), ctx
                     )
                     lsm = cur[(st.other_input, st.lookup_key)]
-                    parts, f = lsm_join(probe, lsm, (caps.join_out,) * caps.levels)
+                    parts, f = lsm_join(probe, lsm, caps.join_caps(probe.cap, lsm))
                     ctx.overflow.append(f)
                     stream = self._union_outs(parts, caps.join_out, ctx)
                 outs_all.append(
@@ -688,7 +722,20 @@ class FusedDataflow:
         caps: Optional[FusedCaps] = None,
         mesh=None,
         axis_name: str = "workers",
+        traces=None,
     ):
+        # `traces`: the host TraceManager, when arrangement sharing is on.
+        # Fused state is device-resident and cannot import a host spine, so
+        # a plan whose stateful operators would IMPORT an existing shared
+        # trace yields to the host renderer (which gets the sharing win);
+        # with no importable trace the fused render proceeds privately —
+        # it simply doesn't export, and later host dataflows export their
+        # own (the FusedUnsupported-without-breaking-the-fallback contract).
+        if traces is not None:
+            from ..arrangement.trace_manager import shared_trace_keys
+
+            if any(k in traces.traces for k in shared_trace_keys(desc)):
+                raise FusedUnsupported("shared-trace import (host-resident spine)")
         self.desc = desc
         self.caps = caps or FusedCaps()
         self.mesh = mesh

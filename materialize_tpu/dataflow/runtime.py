@@ -252,14 +252,57 @@ class ArrangeByNode(Node):
         return [("arrange_by", len(self.arr.batches), self.arr.total_cap(), self.arr.count())]
 
 
+def _shared_state_info(h) -> tuple:
+    """(batches, cap, records) to REPORT for a shared trace handle: the
+    exporter owns the memory; importers report zero cap/records so summing
+    mz_arrangement_sizes across dataflows counts every shared trace once."""
+    nb, cap, rec = h.trace.state_info()
+    if h.imported:
+        return nb, 0, 0
+    return nb, cap, rec
+
+
+class SharedArrangeNode(Node):
+    """ArrangeBy over a shared trace: pass the delta through, offering it to
+    the trace (one LSM insert per tick TOTAL across every reader — the
+    arrangement-sharing contract) instead of maintaining a private spine."""
+
+    def __init__(self, handle, key_cols: tuple[int, ...]):
+        self.h = handle
+        self.key_cols = key_cols
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is not None:
+            self.h.offer(tick, arrange_batch(oks, self.key_cols))
+        return oks, errs
+
+    def state_info(self):
+        return [(self.h.name(),) + _shared_state_info(self.h)]
+
+
 class LinearJoinNode(Node):
     """Binary join chain; each stage keeps arrangements of both sides
-    (the differential `join_core` shape, linear_join.rs)."""
+    (the differential `join_core` shape, linear_join.rs).
 
-    def __init__(self, jplan: lir.LinearJoinPlan, closure, shard=None):
+    `shared` (one (stream handle, lookup handle) pair per stage, entries
+    None where private) swaps a side's private arrangement for a shared
+    trace: the tick's delta is OFFERED up front (so `thru(t)` includes it)
+    and probes pick the time-consistent view — dA joins the other side
+    THROUGH t, dB joins this side BEFORE t, and the dA⋈dB term is emitted
+    only when the right side is private (a shared right's thru(t) probe
+    already covers it). Stream-side sharing only applies to stage 0, whose
+    stream is an imported collection; later stages accumulate dataflow-
+    private intermediates."""
+
+    def __init__(self, jplan: lir.LinearJoinPlan, closure, shard=None, shared=None):
         self.stages = jplan.stages
         self.closure = closure
         self.shard = shard
+        self.shared = shared or [(None, None) for _ in self.stages]
         # sharded: both sides of every stage exchange by the stage's join key
         # before touching state, so matching rows co-locate (the pact.rs
         # key-hash discipline at the process boundary). Channel allocation
@@ -269,26 +312,44 @@ class LinearJoinNode(Node):
             if shard is not None
             else None
         )
-        self.state: list[tuple[Arrangement, Arrangement]] = [
-            (Arrangement(key_cols=s.stream_key), Arrangement(key_cols=s.lookup_key))
-            for s in self.stages
+        self.state: list[tuple] = [
+            (
+                None if lh is not None else Arrangement(key_cols=s.stream_key),
+                None if rh is not None else Arrangement(key_cols=s.lookup_key),
+            )
+            for s, (lh, rh) in zip(self.stages, self.shared)
         ]
 
-    def _binary(self, stage_i: int, dl: Optional[UpdateBatch], dr: Optional[UpdateBatch]):
+    def _binary(
+        self,
+        stage_i: int,
+        dl: Optional[UpdateBatch],
+        dr: Optional[UpdateBatch],
+        tick: int,
+    ):
         stage = self.stages[stage_i]
         left_arr, right_arr = self.state[stage_i]
+        lh, rh = self.shared[stage_i]
         outs = []
         dlk = arrange_batch(dl, stage.stream_key) if dl is not None else None
         drk = arrange_batch(dr, stage.lookup_key) if dr is not None else None
+        # shared sides absorb the tick's delta first: thru(t) then includes
+        # it, before(t) excludes it — the two views the update rule needs
+        if lh is not None:
+            lh.offer(tick, dlk)
+        if rh is not None:
+            rh.offer(tick, drk)
         if dlk is not None:
-            outs += join_against(dlk, right_arr.batches)
+            right_batches = rh.thru(tick) if rh is not None else right_arr.batches
+            outs += join_against(dlk, right_batches)
         if drk is not None:
-            outs += join_against(drk, left_arr.batches, swap=True)
-        if dlk is not None and drk is not None:
+            left_batches = lh.before(tick) if lh is not None else left_arr.batches
+            outs += join_against(drk, left_batches, swap=True)
+        if rh is None and dlk is not None and drk is not None:
             outs += join_against(dlk, [drk])  # arrange_batch consolidated drk
-        if dlk is not None:
+        if lh is None and dlk is not None:
             left_arr.insert(dlk, already_keyed=True)
-        if drk is not None:
+        if rh is None and drk is not None:
             right_arr.insert(drk, already_keyed=True)
         return _union(outs)
 
@@ -305,7 +366,7 @@ class LinearJoinNode(Node):
                 right = self.shard.exchange(
                     self.channels[i][1], tick, right, st.lookup_key
                 )
-            stream = self._binary(i, stream, right)
+            stream = self._binary(i, stream, right, tick)
         if stream is None and errs is None:
             return None
         if stream is not None and self.closure is not None:
@@ -315,14 +376,23 @@ class LinearJoinNode(Node):
 
     def compact(self, since):
         for l, r in self.state:
-            l.compact(since)
-            r.compact(since)
+            if l is not None:
+                l.compact(since)
+            if r is not None:
+                r.compact(since)
 
     def state_info(self):
         out = []
         for i, (l, r) in enumerate(self.state):
-            out.append((f"join_stage{i}_left", len(l.batches), l.total_cap(), l.count()))
-            out.append((f"join_stage{i}_right", len(r.batches), r.total_cap(), r.count()))
+            lh, rh = self.shared[i]
+            if l is not None:
+                out.append((f"join_stage{i}_left", len(l.batches), l.total_cap(), l.count()))
+            else:
+                out.append((f"join_stage{i}_left:{lh.name()}",) + _shared_state_info(lh))
+            if r is not None:
+                out.append((f"join_stage{i}_right", len(r.batches), r.total_cap(), r.count()))
+            else:
+                out.append((f"join_stage{i}_right:{rh.name()}",) + _shared_state_info(rh))
         return out
 
 
@@ -336,15 +406,21 @@ class DeltaJoinNode(Node):
     decomposition that half_join realizes with per-update time comparison.
     """
 
-    def __init__(self, jplan: lir.DeltaJoinPlan, closure, n_inputs: int, shard=None):
+    def __init__(
+        self, jplan: lir.DeltaJoinPlan, closure, n_inputs: int, shard=None,
+        shared=None,
+    ):
         self.plan = jplan
         self.closure = closure
         self.shard = shard
+        # (input, lookup_key) -> TraceHandle for inputs that are imported
+        # collections: the per-input index reuse that delta joins exist for
+        self.shared: dict = shared or {}
         self.arrs: dict[tuple[int, tuple[int, ...]], Arrangement] = {}
         for path in jplan.paths:
             for st in path:
                 key = (st.other_input, st.lookup_key)
-                if key not in self.arrs:
+                if key not in self.arrs and key not in self.shared:
                     self.arrs[key] = Arrangement(key_cols=st.lookup_key)
         if shard is not None:
             # one channel per half-join hop (the stream re-keys at every
@@ -353,12 +429,39 @@ class DeltaJoinNode(Node):
             self.path_channels = [
                 [shard.alloc_channel() for _ in path] for path in jplan.paths
             ]
-            self.arr_channels = {key: shard.alloc_channel() for key in self.arrs}
+            self.arr_channels = {
+                key: shard.alloc_channel()
+                for key in list(self.arrs) + list(self.shared)
+            }
+
+    def _lookup_batches(self, k: int, st, tick: int) -> list:
+        """Arrangement contents path k must see for stage `st`: shared
+        traces expose the sequential-update decomposition by time (inputs
+        j<k through t, j>k before t) instead of by insertion order."""
+        key = (st.other_input, st.lookup_key)
+        h = self.shared.get(key)
+        if h is None:
+            return self.arrs[key].batches
+        return h.thru(tick) if st.other_input < k else h.before(tick)
 
     def step(self, tick, ins):
         errs = _union([d[1] for d in ins if d is not None])
         outs = []
         sharded = self.shard is not None
+        # shared arrangements absorb their input's tick delta up front:
+        # offers are idempotent (first reader wins) and the thru/before
+        # views encode the per-path time split
+        for (inp, key), h in self.shared.items():
+            dk = ins[inp][0] if ins[inp] is not None else None
+            routed = dk
+            if sharded:
+                routed = self.shard.exchange(
+                    self.arr_channels[(inp, key)], tick, dk, key
+                )
+            h.offer(
+                tick,
+                arrange_batch(routed, key) if routed is not None else None,
+            )
         for k, path in enumerate(self.plan.paths):
             dk = ins[k][0] if ins[k] is not None else None
             stream = dk
@@ -374,13 +477,15 @@ class DeltaJoinNode(Node):
                 if stream is None:
                     continue
                 probe = arrange_batch(stream, st.stream_key)
-                arr = self.arrs[(st.other_input, st.lookup_key)]
-                stream = _union(join_against(probe, arr.batches))
+                stream = _union(
+                    join_against(probe, self._lookup_batches(k, st, tick))
+                )
             if stream is not None:
                 outs.append(_project(stream, self.plan.permutations[k]))
-            # now publish input k's delta to its arrangements (sharded: the
-            # delta is exchanged by each arrangement's key first, so every
-            # partitioned arrangement holds exactly the rows it owns)
+            # now publish input k's delta to its PRIVATE arrangements
+            # (sharded: the delta is exchanged by each arrangement's key
+            # first, so every partitioned arrangement holds exactly the rows
+            # it owns); shared ones were offered above
             for (inp, key), arr in self.arrs.items():
                 if inp != k:
                     continue
@@ -404,10 +509,16 @@ class DeltaJoinNode(Node):
             arr.compact(since)
 
     def state_info(self):
-        return [
+        out = [
             (f"delta_in{inp}_key{list(key)}", len(a.batches), a.total_cap(), a.count())
             for (inp, key), a in self.arrs.items()
         ]
+        for (inp, key), h in self.shared.items():
+            out.append(
+                (f"delta_in{inp}_key{list(key)}:{h.name()}",)
+                + _shared_state_info(h)
+            )
+        return out
 
 
 class ReduceNode(Node):
@@ -435,6 +546,64 @@ class ReduceNode(Node):
 
     def state_info(self):
         return [("reduce_accums", 1, self.state.cap, int(self.state.count()))]
+
+
+class SharedReduceNode(Node):
+    """Accumulable reduce over a shared aggregate trace: the accumulator
+    table steps ONCE per tick across every reader (SharedReduceTrace
+    memoizes the emission), and an importing dataflow hydrates from the
+    trace's cumulative output snapshot instead of re-aggregating its input
+    snapshot."""
+
+    def __init__(self, handle):
+        self.h = handle
+
+    def step(self, tick, ins):
+        d = ins[0]
+        if self.h._hydrating(tick):
+            if self.h.trusted:
+                # live peek: the shared state already reflects the collection
+                # through this tick; the input snapshot is the telescoped
+                # history it was built from and must not be double-applied
+                out, agg_errs = self.h.trace.snapshot(tick)
+            else:
+                # installed import: the trace is NOT trusted at as_of (a
+                # reconciliation replay re-creates dataflows before any
+                # re-stepping) — aggregate our own input snapshot privately;
+                # the shared state takes over from the first post-as_of tick
+                out, agg_errs = self._private_hydration(tick, d)
+            errs = _union([d[1] if d is not None else None, agg_errs])
+            if out is None and errs is None:
+                return None
+            return out, errs
+        if d is None:
+            return None
+        oks, errs = d
+        if oks is None:
+            return None if errs is None else (None, errs)
+        out, agg_errs = self.h.trace.step(tick, oks)
+        return out, _union([errs, agg_errs])
+
+    def _private_hydration(self, tick, d):
+        """Aggregate the hydration snapshot against an empty throwaway
+        accumulator (exactly what a private ReduceNode would emit)."""
+        if d is None or d[0] is None:
+            return None, None
+        from ..ops.reduce import AccumState, accumulable_step
+
+        tr = self.h.trace
+        scratch = AccumState.empty(
+            8,
+            tuple(k.dtype for k in tr.state.keys),
+            tuple(a.dtype for a in tr.state.accums),
+        )
+        _state, out, errs = accumulable_step(
+            scratch, d[0], tr.key_cols, tr.aggs, tick
+        )
+        return out, errs
+
+    def state_info(self):
+        return [(self.h.name(),) + _shared_state_info(self.h)]
 
 
 class FusedMfpReduceNode(Node):
@@ -1100,11 +1269,30 @@ class Dataflow:
     through the operator DAG in dependency order, update exported traces.
     """
 
-    def __init__(self, desc: lir.DataflowDescription, shard: ShardContext | None = None):
+    def __init__(
+        self,
+        desc: lir.DataflowDescription,
+        shard: ShardContext | None = None,
+        traces=None,
+        trace_reader: str | None = None,
+        trace_export: bool = True,
+    ):
         # `shard`: render as ONE worker of a multi-process sharded replica —
         # exchange pacts are inserted in front of every stateful operator and
         # all workers must step the same tick sequence (see cluster/mesh.py)
+        #
+        # `traces`: a TraceManager for cross-dataflow arrangement sharing
+        # (arrangement/trace_manager.py). Stateful operators over imported
+        # collections import a matching shared trace when one exists, else
+        # build and EXPORT one for later dataflows; every use registers
+        # `trace_reader`'s since hold at desc.as_of. `trace_export=False`
+        # (ephemeral peek dataflows) imports only — a trace exported by a
+        # dataflow that dies after one tick would go stale immediately.
         self.shard = shard
+        self.traces = traces
+        self._trace_reader = trace_reader
+        self._trace_export = trace_export
+        self._trace_handles: dict = {}
         self.desc = desc
         self.has_temporal = False  # temporal filters need stepping every tick
         self.builds: list = []  # (obj_id, [(node, input_refs)], out_ref)
@@ -1213,6 +1401,72 @@ class Dataflow:
         self._memo[memo_key] = ref
         return ref
 
+    def _shareable_gid(self, expr):
+        """The collection id of `expr` when it is shareable, else None.
+        Sharing keys on IMPORTED collection ids only (source_imports):
+        those are stable across dataflows; built-object ids are private."""
+        if self.traces is None or not isinstance(expr, lir.Get):
+            return None
+        return expr.id if expr.id in self.desc.source_imports else None
+
+    def _shared_handle(self, key: tuple, getter):
+        """Memoized TraceHandle for trace `key` (one handle per dataflow
+        per key — every site of this render shares it), or None when the
+        manager has nothing usable. Peek renders (trace_export=False) get
+        trusted handles: only a live coordinator may read a trace at the
+        importer's as_of (see TraceHandle)."""
+        from ..arrangement.trace_manager import TraceHandle
+
+        hit = self._trace_handles.get(key)
+        if hit is not None:
+            return hit
+        tr, imported = getter()
+        if tr is None:
+            return None
+        h = TraceHandle(
+            tr, imported, self.desc.as_of, trusted=not self._trace_export
+        )
+        self._trace_handles[key] = h
+        return h
+
+    def _shared_arrangement(self, expr, key_cols: tuple[int, ...]):
+        """TraceHandle for an arrangement of `expr` by `key_cols`, or None."""
+        gid = self._shareable_gid(expr)
+        if gid is None:
+            return None
+        from ..arrangement.trace_manager import TraceManager
+
+        return self._shared_handle(
+            TraceManager.arrangement_key(gid, tuple(key_cols)),
+            lambda: self.traces.get_arrangement(
+                gid,
+                tuple(key_cols),
+                self._trace_reader,
+                self.desc.as_of,
+                export=self._trace_export,
+            ),
+        )
+
+    def _shared_reduce(self, e: lir.Reduce, in_dtypes: tuple):
+        """TraceHandle for a shared accumulable reduce over a Get, or None."""
+        gid = self._shareable_gid(e.input)
+        if gid is None:
+            return None
+        from ..arrangement.trace_manager import TraceManager
+
+        return self._shared_handle(
+            TraceManager.reduce_key(gid, e.key_cols, e.aggs),
+            lambda: self.traces.get_reduce(
+                gid,
+                e.key_cols,
+                e.aggs,
+                in_dtypes,
+                self._trace_reader,
+                self.desc.as_of,
+                export=self._trace_export,
+            ),
+        )
+
     def _exchanged(self, ref, key_cols, ops: list):
         """In sharded mode, interpose an exchange pact routing by `key_cols`
         (None = whole row) so the downstream stateful operator only ever sees
@@ -1246,17 +1500,54 @@ class Dataflow:
             ops.append((UnionNode(), refs))
             return len(ops) - 1
         if isinstance(e, lir.ArrangeBy):
+            h = self._shared_arrangement(e.input, e.key_cols)
             ref = self._render(e.input, ops)
             ref = self._exchanged(ref, e.key_cols, ops)
-            ops.append((ArrangeByNode(e.key_cols), [ref]))
+            if h is not None:
+                ops.append((SharedArrangeNode(h, e.key_cols), [ref]))
+            else:
+                ops.append((ArrangeByNode(e.key_cols), [ref]))
             return len(ops) - 1
         if isinstance(e, lir.Join):
             refs = [self._render(i, ops) for i in e.inputs]
             if isinstance(e.plan, lir.LinearJoinPlan):
-                ops.append((LinearJoinNode(e.plan, e.closure, shard=self.shard), refs))
-            else:
+                shared = []
+                for si, st in enumerate(e.plan.stages):
+                    lh = (
+                        self._shared_arrangement(e.inputs[0], st.stream_key)
+                        if si == 0
+                        else None
+                    )
+                    rh = self._shared_arrangement(e.inputs[si + 1], st.lookup_key)
+                    shared.append((lh, rh))
                 ops.append(
-                    (DeltaJoinNode(e.plan, e.closure, len(refs), shard=self.shard), refs)
+                    (
+                        LinearJoinNode(
+                            e.plan, e.closure, shard=self.shard, shared=shared
+                        ),
+                        refs,
+                    )
+                )
+            else:
+                shared = {}
+                for path in e.plan.paths:
+                    for st in path:
+                        key = (st.other_input, st.lookup_key)
+                        if key in shared:
+                            continue
+                        h = self._shared_arrangement(
+                            e.inputs[st.other_input], st.lookup_key
+                        )
+                        if h is not None:
+                            shared[key] = h
+                ops.append(
+                    (
+                        DeltaJoinNode(
+                            e.plan, e.closure, len(refs), shard=self.shard,
+                            shared=shared,
+                        ),
+                        refs,
+                    )
                 )
             return len(ops) - 1
         if isinstance(e, lir.Reduce):
@@ -1287,7 +1578,11 @@ class Dataflow:
             if e.distinct:
                 ops.append((DistinctNode(e.key_cols, in_dt), [ref]))
             else:
-                ops.append((ReduceNode(e, in_dt), [ref]))
+                h = self._shared_reduce(e, in_dt)
+                if h is not None:
+                    ops.append((SharedReduceNode(h), [ref]))
+                else:
+                    ops.append((ReduceNode(e, in_dt), [ref]))
             return len(ops) - 1
         if isinstance(e, lir.BasicAgg):
             ref = self._render(e.input, ops)
@@ -1489,6 +1784,11 @@ class Dataflow:
             arr.compact(since)
         for arr in self.index_errs.values():
             arr.compact(since)
+        if self.traces is not None and self._trace_reader is not None:
+            # advance this reader's since holds; each shared trace compacts
+            # to the minimum over its remaining holds (AllowCompaction under
+            # the reader-held protocol)
+            self.traces.downgrade(self._trace_reader, since)
 
 
 def _truncate_until(b: Optional[UpdateBatch], until: int) -> Optional[UpdateBatch]:

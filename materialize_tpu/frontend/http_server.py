@@ -161,6 +161,16 @@ class SqlHandler(BaseHTTPRequestHandler):
         ]
         for name, value in sorted(c.overload.snapshot().items()):
             lines.append(f'mzt_overload_counter{{name="{name}"}} {value}')
+        tm = c.trace_manager
+        lines += [
+            "# TYPE mzt_shared_traces gauge",
+            f"mzt_shared_traces {tm.trace_count()}",
+            "# TYPE mzt_trace_import_hit_rate gauge",
+            f"mzt_trace_import_hit_rate {tm.import_hit_rate():.6f}",
+            "# TYPE mzt_trace_sharing_counter counter",
+        ]
+        for name, value in sorted(tm.stats.items()):
+            lines.append(f'mzt_trace_sharing_counter{{name="{name}"}} {value}')
         lines += [
             "# TYPE mzt_admission_queue_depth gauge",
             f'mzt_admission_queue_depth{{gate="statement"}} {c.admission.depth}',

@@ -43,11 +43,11 @@ I64 = np.dtype(np.int64)
 RATIO = 8  # LSM merge ratio
 
 
-def level_caps(full: int, small: int, k: int = 3) -> tuple:
+def level_caps(full: int, small: int, k: int = 3, ratio: int = RATIO) -> tuple:
     """Geometric level capacities (small, …, full)."""
     caps = [full]
     for _ in range(k - 1):
-        caps.append(max(bucket_cap(small), caps[-1] // RATIO))
+        caps.append(max(bucket_cap(small), caps[-1] // max(int(ratio), 2)))
     caps.reverse()
     # monotone non-decreasing
     for i in range(1, k):

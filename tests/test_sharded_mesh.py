@@ -254,6 +254,71 @@ def test_epoch_fenced_shard_restart(sharded_cluster):
     assert ctl.peek("df1", "idx_bids_sum") == [(10, 350, 2), (11, 100, 2)]
 
 
+def test_sharded_multi_dataflow_sharing_and_reform(sharded_cluster):
+    """Multiple dataflows over the SAME sources on a 2-process sharded
+    replica (PR 9): per-worker shared traces keep every reader byte-identical
+    to the 1-process path through churn, a late import (create at as_of > 0
+    hydrates from the shared trace), and a kill + epoch-bumped reform whose
+    history replay must rebuild every since hold."""
+    orch, blob_path, cas_path, blob, cas, ctls = sharded_cluster
+    auctions = ShardMachine(blob, cas, "auctions")
+    bids = ShardMachine(blob, cas, "bids")
+
+    addrs, mesh_addrs = orch.ensure_sharded_service("share", 2, workers_per_process=2)
+    ctl = ShardedComputeController(addrs, mesh_addrs, 2, blob_path, cas_path, epoch=1)
+    ctls.append(ctl)
+    single = ComputeController(
+        orch.ensure_service("share_single", scale=1), blob_path, cas_path, epoch=1
+    )
+    ctls.append(single)
+
+    src2 = {"auctions": "auctions", "bids": "bids"}
+    for c_ in (ctl, single):
+        c_.create_dataflow("j1", auction.auctions_join_bids(), src2, as_of=0)
+        c_.create_dataflow("s1", auction.bids_sum_count(), {"bids": "bids"}, as_of=0)
+
+    write_rows(auctions, 0, 1, [(a, a + 10, 5, 99, 1) for a in range(1, 7)], 4)
+    write_rows(bids, 0, 1, [(b, 50 + b, (b % 6) + 1, 100 + b, 7, 1) for b in range(12)], 5)
+    write_rows(bids, 2, 2, [(20, 99, 3, 500, 8, 1), (1, 51, 2, 101, 7, -1)], 5)
+    for c_ in (ctl, single):
+        c_.process_to(3)
+
+    # late readers over the same sources: hydrate at as_of=2 by importing
+    # the traces j1/s1 exported (identical plans → identical trace keys)
+    for c_ in (ctl, single):
+        c_.create_dataflow("j2", auction.auctions_join_bids(), src2, as_of=2)
+        c_.create_dataflow("s2", auction.bids_sum_count(), {"bids": "bids"}, as_of=2)
+    write_rows(auctions, 2, 3, [(9, 19, 5, 99, 1)], 4)
+    write_rows(bids, 3, 3, [(21, 77, 5, 333, 9, 1), (2, 52, 3, 102, 7, -1)], 5)
+    for c_ in (ctl, single):
+        c_.process_to(4)
+    views = [("j1", "idx_join"), ("j2", "idx_join"),
+             ("s1", "idx_bids_sum"), ("s2", "idx_bids_sum")]
+    before = {}
+    for df_id, idx in views:
+        got = ctl.peek(df_id, idx)
+        assert got == single.peek(df_id, idx), (df_id, idx)
+        before[df_id] = got
+    assert before["j1"] == before["j2"] and before["s1"] == before["s2"]
+    assert len(before["j1"]) > 0 and len(before["s1"]) > 0
+
+    # kill one shard; reform at a bumped epoch replays history — the fresh
+    # per-worker TraceManagers must re-export traces and re-register holds
+    orch.kill_replica("share", 0)
+    orch.restart_replica("share", 0)
+    ctl.reform()
+    for df_id, idx in views:
+        assert ctl.peek(df_id, idx) == before[df_id], f"{df_id} diverged post-reform"
+
+    # and the reformed mesh keeps maintaining the SHARED traces correctly
+    write_rows(bids, 4, 4, [(22, 60, 1, 999, 9, 1)], 5)
+    for c_ in (ctl, single):
+        c_.process_to(5)
+    for df_id, idx in views:
+        assert ctl.peek(df_id, idx) == single.peek(df_id, idx), (df_id, idx)
+    assert ctl.peek("j1", "idx_join") != before["j1"]  # churn really landed
+
+
 def test_coordinator_replica_sizes(tmp_path):
     """adapter: '2x4' parses to 2 processes × 4 workers; bad sizes error."""
     from materialize_tpu.adapter.coordinator import parse_replica_size
