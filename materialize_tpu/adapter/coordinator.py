@@ -10,6 +10,7 @@ feed storage collections (the persist-sink shape, sink/materialized_view.rs).
 
 from __future__ import annotations
 
+import time as _time
 from dataclasses import dataclass, field
 from time import monotonic as _monotonic
 from typing import Any, Optional
@@ -22,6 +23,8 @@ from ..arrangement.spine import Arrangement
 from ..dataflow import Dataflow
 from ..dataflow import plan as lir
 from ..expr import relation as mir
+from ..obs import log as obs_log
+from ..obs import metrics as obs_metrics
 from ..ops.consolidate import advance_times, consolidate
 from ..repr.batch import UpdateBatch
 from ..repr.types import ColType, ColumnDesc, RelationDesc
@@ -33,6 +36,16 @@ from ..storage.generator import AuctionGenerator, CounterGenerator, TpchGenerato
 from ..transform import optimize
 from .catalog import Catalog, CatalogItem, coltype_of
 from .timestamp_oracle import TimestampOracle
+
+_log = obs_log.get_logger("coord")
+
+# Per-dataflow write-tick duration (the coordinator's in-process dataflows;
+# clusterd's come back merged in StatsReport) — a /metrics histogram family.
+_TICK_NS = obs_metrics.REGISTRY.histogram(
+    "mzt_dataflow_tick_duration_ns",
+    "duration of one dataflow step at one write timestamp",
+    labels=("dataflow",),
+)
 
 
 @dataclass
@@ -154,6 +167,9 @@ class Coordinator:
         self.oracle = TimestampOracle()
         self.storage: dict[str, StorageCollection] = {}
         self.generators: list = []  # (generator, {table -> gid})
+        # per-source ingestion statistics (mz_source_statistics): resume
+        # offset, cumulative bytes/records, last-update wall clock (lag)
+        self.source_stats: dict[str, dict] = {}
         # installed continuous dataflows in dependency order: (mv_gid, Dataflow, src_gids)
         self.dataflows: list = []
         self.planner = Planner(self.catalog)
@@ -267,7 +283,18 @@ class Coordinator:
                 session.arrival = None
         self._deadline = t0 + timeout_ms / 1000.0 if timeout_ms > 0 else None
         try:
-            with TRACER.span(f"execute:{type(stmt).__name__}"):
+            # a top-level statement mints a fresh TRACE (its context rides
+            # CTP to clusterd and remote spans ship back — obs/spans.py); a
+            # nested execute (EXPLAIN TIMELINE's inner run) records a child
+            # span in the enclosing trace instead
+            name = f"execute:{type(stmt).__name__}"
+            cm = (
+                TRACER.span(name)
+                if TRACER.current_context() is not None
+                else TRACER.trace(name)
+            )
+            with cm as s:
+                self.last_trace_id = s.trace_id
                 return self._execute_stmt_inner(stmt)
         except Exception as e:
             from ..errors import ResultSizeExceeded
@@ -347,6 +374,19 @@ class Coordinator:
                 from ..utils.tracing import TRACER
 
                 TRACER.set_filter(self._cfg().get("log_filter"))
+            elif stmt.name == "enable_operator_logging":
+                # flip LIVE dataflows too — newly rendered ones read the
+                # config at construction (_make_dataflow)
+                on = bool(self._cfg().get("enable_operator_logging"))
+                for _gid, df, _srcs in self.dataflows:
+                    df.operator_logging = on
+            elif stmt.name in ("enable_jax_profiler", "jax_profiler_dir"):
+                from ..obs import profiler
+
+                profiler.configure(
+                    bool(self._cfg().get("enable_jax_profiler")),
+                    str(self._cfg().get("jax_profiler_dir")),
+                )
             return ExecResult("status", status="SET")
         if isinstance(stmt, ast.ResetVariable):
             if stmt.name not in self.configs.names():
@@ -817,6 +857,7 @@ class Coordinator:
         enabled and expressible, else the host-orchestrated operator graph
         (the rendering-choice analogue of ENABLE_MZ_JOIN_CORE)."""
         traces = self._traces() if trace_reader is not None else None
+        oplog = bool(self.configs.get("enable_operator_logging"))
         if bool(self.configs.get("enable_fused_render")):
             from ..dataflow.fused import FusedCaps, FusedDataflow, FusedUnsupported
 
@@ -825,7 +866,13 @@ class Coordinator:
                 cap_ratio=int(self.configs.get("fused_join_cap_ratio")),
             )
             try:
-                df = FusedDataflow(desc, caps=caps, mesh=self.mesh, traces=traces)
+                df = FusedDataflow(
+                    desc,
+                    caps=caps,
+                    mesh=self.mesh,
+                    traces=traces,
+                    operator_logging=oplog,
+                )
                 if snaps:
                     # pre-size so the hydration tick doesn't ladder through
                     # doubling retries on large input snapshots
@@ -835,7 +882,12 @@ class Coordinator:
                 return df
             except FusedUnsupported:
                 pass
-        return Dataflow(desc, traces=traces, trace_reader=trace_reader)
+        return Dataflow(
+            desc,
+            traces=traces,
+            trace_reader=trace_reader,
+            operator_logging=oplog,
+        )
 
     def _encode_val(self, v, cd):
         """Re-encode a decoded row value to its storage representation:
@@ -1232,12 +1284,11 @@ class Coordinator:
         n = int(correction.count())
         if not n:
             return
-        import sys
-
-        print(
-            f"WARNING: boot mv shard reconciliation: durable shard {gid} "
-            f"diverged from its recomputed view by {n} rows; healing",
-            file=sys.stderr,
+        _log.warn(
+            "boot mv shard reconciliation: durable shard diverged from "
+            "its recomputed view; healing",
+            shard=gid,
+            rows=n,
         )
         # epoch=None: reconciliation runs pre-leadership (before the fence
         # bump); read_only boots skip it entirely
@@ -1400,7 +1451,9 @@ class Coordinator:
                     if corr is not None:
                         corrections[mv_gid] = corr
                 continue
+            _t0 = _monotonic()
             results = df.step(ts, deltas)
+            _TICK_NS.observe((_monotonic() - _t0) * 1e9, dataflow=mv_gid)
             out = results.get(mv_gid)
             if out is not None and out[0] is not None:
                 env[mv_gid] = out[0]
@@ -1458,14 +1511,14 @@ class Coordinator:
         n = int(correction.count())
         if not n:
             return None
-        import sys
-
         from ..repr.batch import bucket_cap
 
-        print(
-            f"WARNING: mv sink self-correction: {mv_gid} diverged from "
-            f"its dataflow by {n} rows at ts {ts}; healing",
-            file=sys.stderr,
+        _log.warn(
+            "mv sink self-correction: collection diverged from its "
+            "dataflow; healing",
+            mv=mv_gid,
+            rows=n,
+            ts=ts,
         )
         self.mv_corrections = getattr(self, "mv_corrections", 0) + n
         correction = correction.with_capacity(bucket_cap(n))
@@ -1576,6 +1629,11 @@ class Coordinator:
             for t, b in batches.items():
                 if t in gids:
                     writes[gids[t]] = b
+                    self._note_source_progress(
+                        gids[t],
+                        records=int(b.count()),
+                        nbytes=batch_bytes_estimate(b),
+                    )
         remap, committed = self._poll_file_sources(writes, ts, n_rows, budget)
         if budget.yields:
             self.overload.bump("ingest_yields", budget.yields)
@@ -1706,6 +1764,44 @@ class Coordinator:
                 last = e
         raise RuntimeError(f"no replica could serve peek {index_id}: {last}")
 
+    def replica_stats(self) -> list:
+        """[(replica_name, StatsReport)] merged from every live replica's
+        FetchStats — the coordinator-side half of the partitioned-peek-style
+        introspection merge (the per-process halves are summed in clusterd).
+
+        Cached for `introspection_interval_s` so a burst of introspection
+        peeks or /metrics scrapes costs one CTP round-trip, and fail-soft:
+        a degraded or unreachable replica drops out of the snapshot instead
+        of failing the read."""
+        interval = float(self.configs.get("introspection_interval_s"))
+        cache = getattr(self, "_introspection_cache", None)
+        now = _monotonic()
+        if cache is not None and interval > 0 and now - cache[0] < interval:
+            return cache[1]
+        reports: list = []
+        for name, (ctl, _orch, _owned) in self._compute_replicas.items():
+            if getattr(ctl, "degraded", False):
+                continue
+            try:
+                for rep in ctl.fetch_stats():
+                    reports.append((name, rep))
+            except (ConnectionError, OSError, RuntimeError):
+                continue
+        self._introspection_cache = (now, reports)
+        return reports
+
+    def _note_source_progress(
+        self, gid: str, records: int = 0, nbytes: int = 0, offset=None
+    ) -> None:
+        st = self.source_stats.setdefault(
+            gid, {"offset": 0, "bytes": 0, "records": 0, "updated": 0.0}
+        )
+        st["records"] += int(records)
+        st["bytes"] += int(nbytes)
+        if offset is not None:
+            st["offset"] = int(offset)
+        st["updated"] = _time.time()
+
     # -- external file sources -------------------------------------------------
     def _poll_file_sources(self, writes: dict, ts: int, max_records: int,
                            budget=None):
@@ -1756,6 +1852,12 @@ class Coordinator:
                         budget.note_yield()
             if new_offset == src.offset:
                 continue
+            self._note_source_progress(
+                gid,
+                records=len(records),
+                nbytes=new_offset - src.offset,
+                offset=new_offset,
+            )
             backup = None
             if upsert_state is not None:
                 backup = (upsert_state, dict(upsert_state.state))
@@ -1871,47 +1973,52 @@ class Coordinator:
     def _select(self, query: ast.Query) -> ExecResult:
         import time as _time
 
+        from ..utils.tracing import TRACER
+
         t0 = _time.perf_counter_ns()
         self.check_cancellation()
-        pq = self.planner.plan_query(query)
-        rel = optimize(pq.mir, self._cfg())
+        with TRACER.span("plan"):
+            pq = self.planner.plan_query(query)
+            rel = optimize(pq.mir, self._cfg())
         as_of = self.oracle.read_ts()
 
-        rows = self._peek_fast_path(rel, as_of)
+        with TRACER.span("peek"):
+            rows = self._peek_fast_path(rel, as_of)
         if rows is None:
-            self.slow_path_peeks = getattr(self, "slow_path_peeks", 0) + 1
-            src_gids = sorted(_collect_gets(rel))
-            env = {g: self.storage[g].dtypes for g in src_gids}
-            desc = lower_to_dataflow(
-                "peek", rel, env, src_gids, as_of=as_of, mono_ids=self._mono_ids(),
-                until=as_of + 1,
-            )
-            # ephemeral peeks IMPORT shared traces (export=False: a trace
-            # exported by a one-tick dataflow would instantly go stale) and
-            # hold them at as_of for the peek's lifetime; get_arrangement
-            # validates as_of against each shared since — a trace compacted
-            # past as_of is skipped so the peek renders privately from
-            # snapshots instead of reading a partial history
-            tm = self._traces()
-            peek_reader = None
-            if tm is not None:
-                self._peek_seq = getattr(self, "_peek_seq", 0) + 1
-                peek_reader = f"_peek_{self._peek_seq}"
-            try:
-                df = Dataflow(
-                    desc, traces=tm, trace_reader=peek_reader, trace_export=False
+            with TRACER.span("peek:slow_path"):
+                self.slow_path_peeks = getattr(self, "slow_path_peeks", 0) + 1
+                src_gids = sorted(_collect_gets(rel))
+                env = {g: self.storage[g].dtypes for g in src_gids}
+                desc = lower_to_dataflow(
+                    "peek", rel, env, src_gids, as_of=as_of, mono_ids=self._mono_ids(),
+                    until=as_of + 1,
                 )
-                # the ephemeral dataflow is cancel-safe: no shared state to
-                # tear, so the tick loop checks the deadline between every
-                # dispatch
-                df.cancel_check = self.check_cancellation
-                snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
-                df.step(as_of, snaps)
-                rows = df.peek("idx_peek", byte_budget=self._result_budget())
-            finally:
+                # ephemeral peeks IMPORT shared traces (export=False: a trace
+                # exported by a one-tick dataflow would instantly go stale) and
+                # hold them at as_of for the peek's lifetime; get_arrangement
+                # validates as_of against each shared since — a trace compacted
+                # past as_of is skipped so the peek renders privately from
+                # snapshots instead of reading a partial history
+                tm = self._traces()
+                peek_reader = None
                 if tm is not None:
-                    # the peek expiring releases its holds (compaction re-arms)
-                    tm.release(peek_reader)
+                    self._peek_seq = getattr(self, "_peek_seq", 0) + 1
+                    peek_reader = f"_peek_{self._peek_seq}"
+                try:
+                    df = Dataflow(
+                        desc, traces=tm, trace_reader=peek_reader, trace_export=False
+                    )
+                    # the ephemeral dataflow is cancel-safe: no shared state to
+                    # tear, so the tick loop checks the deadline between every
+                    # dispatch
+                    df.cancel_check = self.check_cancellation
+                    snaps = {g: self.storage[g].snapshot(as_of) for g in src_gids}
+                    df.step(as_of, snaps)
+                    rows = df.peek("idx_peek", byte_budget=self._result_budget())
+                finally:
+                    if tm is not None:
+                        # the peek expiring releases its holds (compaction re-arms)
+                        tm.release(peek_reader)
         rows = self._finish(rows, pq)
         self._record_peek(_time.perf_counter_ns() - t0)
         return ExecResult("rows", rows=rows, columns=tuple(c.name for c in pq.scope.cols))
@@ -2104,6 +2211,22 @@ class Coordinator:
     # -- introspection ---------------------------------------------------------
     def _explain(self, stmt: ast.Explain) -> ExecResult:
         inner = stmt.statement
+        if stmt.stage == "timeline":
+            # run the inner statement under a fresh trace, then render the
+            # end-to-end span tree — including clusterd-side spans absorbed
+            # from TracedResponses (obs/spans.py)
+            from ..obs.spans import TRACER, render_timeline
+
+            with TRACER.trace(f"timeline:{type(inner).__name__}") as root:
+                # through execute_stmt, not _execute_stmt_inner: the nested
+                # call records its "execute:<Stmt>" span as a child here
+                self.execute_stmt(inner)
+            spans = TRACER.spans_for_trace(root.trace_id)
+            return ExecResult(
+                "rows",
+                rows=[(line,) for line in render_timeline(spans)],
+                columns=("timeline",),
+            )
         if stmt.stage == "timestamp" and isinstance(inner, ast.SelectStatement):
             pq = self.planner.plan_query(inner.query)
             rel = optimize(pq.mir, self._cfg())

@@ -204,6 +204,36 @@ SOURCE_INGEST_BUDGET = Config(
     "mz_overload_counters.ingest_yields (0 = off)",
 )
 
+# -- observability (obs/: operator logging, introspection, profiling) --------
+ENABLE_OPERATOR_LOGGING = Config(
+    "enable_operator_logging",
+    False,
+    "accumulate per-operator row counts (rows in/out) alongside the always-on "
+    "elapsed/invocation counters, feeding mz_dataflow_operator_rates; off (the "
+    "default) adds no per-row work on the tick path — the zero-overhead-when-"
+    "off guarantee the overhead-guard benchmark enforces",
+)
+INTROSPECTION_INTERVAL = Config(
+    "introspection_interval_s",
+    1.0,
+    "seconds a merged replica stats snapshot (FetchStats over CTP) stays "
+    "cached before an introspection peek or /metrics scrape refreshes it; "
+    "0 = fetch on every read",
+)
+ENABLE_JAX_PROFILER = Config(
+    "enable_jax_profiler",
+    False,
+    "start a jax.profiler trace (into jax_profiler_dir) and annotate each "
+    "fused tick with its dataflow name so device time attributes to plan "
+    "nodes (obs/profiler.py); shipped to clusterd in CreateInstance.config",
+)
+JAX_PROFILER_DIR = Config(
+    "jax_profiler_dir",
+    "",
+    "dump directory for jax.profiler traces (empty = annotation-only, no "
+    "trace collection)",
+)
+
 ALL_CONFIGS = [
     MV_SINK_SELF_CORRECT,
     CTP_MAX_FRAME_BYTES,
@@ -226,6 +256,10 @@ ALL_CONFIGS = [
     MEMORY_LIMIT_MB,
     COMPACTION_WINDOW,
     FUSED_RENDER,
+    ENABLE_OPERATOR_LOGGING,
+    INTROSPECTION_INTERVAL,
+    ENABLE_JAX_PROFILER,
+    JAX_PROFILER_DIR,
 ]
 
 

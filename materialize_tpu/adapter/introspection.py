@@ -45,12 +45,39 @@ INTROSPECTION_TABLES = {
         ("operator_type", ColType.STRING),
         ("elapsed_ns", ColType.INT64),
         ("invocations", ColType.INT64),
+        ("replica", ColType.STRING),  # "" = the coordinator's own dataflows
+    ),
+    "mz_dataflow_operator_rates": _desc(
+        ("dataflow", ColType.STRING),
+        ("operator_id", ColType.INT64),
+        ("operator_type", ColType.STRING),
+        ("rows_in", ColType.INT64),
+        ("rows_out", ColType.INT64),
+        ("retries", ColType.INT64),
+        ("replica", ColType.STRING),
+    ),
+    "mz_hydration_statuses": _desc(
+        ("dataflow", ColType.STRING),
+        ("replica", ColType.STRING),
+        ("hydrated", ColType.BOOL),
+        ("frontier", ColType.INT64),
+        ("as_of", ColType.INT64),
+    ),
+    "mz_source_statistics": _desc(
+        ("id", ColType.STRING),
+        ("name", ColType.STRING),
+        ("offset_committed", ColType.INT64),
+        ("bytes_received", ColType.INT64),
+        ("records_received", ColType.INT64),
+        ("lag_ms", ColType.INT64),
     ),
     "mz_trace_spans": _desc(
         ("id", ColType.INT64),
         ("parent", ColType.INT64),
         ("name", ColType.STRING),
         ("duration_ns", ColType.INT64),
+        ("trace_id", ColType.INT64),
+        ("process", ColType.STRING),
     ),
     "mz_peek_durations": _desc(
         ("bucket_ns_le", ColType.INT64),
@@ -76,8 +103,26 @@ INTROSPECTION_TABLES = {
         ("batches", ColType.INT64),
         ("capacity", ColType.INT64),
         ("records", ColType.INT64),
+        ("bytes", ColType.INT64),
+        ("replica", ColType.STRING),
     ),
 }
+
+
+def _replica_operator_stats(coord) -> dict[tuple, list[int]]:
+    """Operator accumulators shipped back from replica processes, merged per
+    (replica, dataflow, operator, type) — several processes of one replica
+    sum into one row, the partitioned-peek merge applied to logging."""
+    merged: dict[tuple, list[int]] = {}
+    for replica, rep in coord.replica_stats():
+        for df_id, _obj, op_i, typ, el, inv, rin, rout, retries in rep.operators:
+            cur = merged.setdefault((replica, df_id, op_i, typ), [0] * 5)
+            cur[0] += int(el)
+            cur[1] += int(inv)
+            cur[2] += int(rin)
+            cur[3] += int(rout)
+            cur[4] += int(retries)
+    return merged
 
 
 def introspection_rows(coord, name: str) -> list[tuple]:
@@ -121,13 +166,45 @@ def introspection_rows(coord, name: str) -> list[tuple]:
         out = []
         for gid, df, _src in coord.dataflows:
             for obj, op_i, typ, el, inv in df.operator_info():
-                out.append((gid, op_i, typ, el, inv))
+                out.append((gid, op_i, typ, el, inv, ""))
+        for (replica, df_id, op_i, typ), v in _replica_operator_stats(coord).items():
+            out.append((df_id, op_i, typ, v[0], v[1], replica))
+        return out
+    if name == "mz_dataflow_operator_rates":
+        out = []
+        for gid, df, _src in coord.dataflows:
+            for obj, op_i, typ, rin, rout, retries in df.operator_rates():
+                out.append((gid, op_i, typ, rin, rout, retries, ""))
+        for (replica, df_id, op_i, typ), v in _replica_operator_stats(coord).items():
+            out.append((df_id, op_i, typ, v[2], v[3], v[4], replica))
+        return out
+    if name == "mz_hydration_statuses":
+        out = []
+        for gid, df, _src in coord.dataflows:
+            as_of = int(getattr(df.desc, "as_of", 0))
+            fr = int(df.frontier)
+            out.append((gid, "", fr > as_of, fr, as_of))
+        for replica, rep in coord.replica_stats():
+            for df_id, fr, as_of in rep.dataflows:
+                out.append((df_id, replica, int(fr) > int(as_of), int(fr), int(as_of)))
+        return out
+    if name == "mz_source_statistics":
+        import time as _t
+
+        gid2name = {i.global_id: i.name for i in cat.items.values()}
+        now = _t.time()
+        out = []
+        for gid, st in sorted(coord.source_stats.items()):
+            lag_ms = int((now - st["updated"]) * 1000) if st["updated"] else 0
+            out.append(
+                (gid, gid2name.get(gid, gid), st["offset"], st["bytes"], st["records"], lag_ms)
+            )
         return out
     if name == "mz_trace_spans":
         from ..utils.tracing import TRACER
 
         return [
-            (s.id, s.parent, s.name, s.duration_ns)
+            (s.id, s.parent, s.name, s.duration_ns, s.trace_id, s.process)
             for s in TRACER.recent()
             if s.duration_ns >= 0
         ]
@@ -149,8 +226,18 @@ def introspection_rows(coord, name: str) -> list[tuple]:
     if name == "mz_arrangement_sizes":
         out = []
         for gid, df, _src in coord.dataflows:
-            for obj, op_i, aname, nb, cap, rec in df.arrangement_info():
-                out.append((gid, op_i, aname, nb, cap, rec))
+            for obj, op_i, aname, nb, cap, rec, b in df.arrangement_info():
+                out.append((gid, op_i, aname, nb, cap, rec, b, ""))
+        merged: dict[tuple, list[int]] = {}
+        for replica, rep in coord.replica_stats():
+            for df_id, _obj, op_i, aname, nb, cap, rec, b in rep.arrangements:
+                cur = merged.setdefault((replica, df_id, op_i, aname), [0] * 4)
+                cur[0] += int(nb)
+                cur[1] += int(cap)
+                cur[2] += int(rec)
+                cur[3] += int(b)
+        for (replica, df_id, op_i, aname), v in merged.items():
+            out.append((df_id, op_i, aname, v[0], v[1], v[2], v[3], replica))
         return out
     raise ValueError(f"unknown introspection relation {name}")
 

@@ -25,6 +25,7 @@ Two execution modes:
 from __future__ import annotations
 
 import argparse
+import os
 import queue
 import socket
 import sys
@@ -35,10 +36,16 @@ import numpy as np
 from ..arrangement.trace_manager import TraceManager
 from ..dataflow import Dataflow
 from ..dataflow.runtime import ShardContext
+from ..obs import log as obs_log
+from ..obs import metrics as obs_metrics
+from ..obs import profiler as obs_profiler
+from ..obs.spans import TRACER
 from ..persist import FileBlob, FileConsensus, ShardMachine
 from ..repr.batch import UpdateBatch
 from . import protocol as p
 from .mesh import MeshError, WorkerMesh
+
+_log = obs_log.get_logger("clusterd")
 
 
 class ShardWorker:
@@ -119,6 +126,7 @@ class ClusterState:
         self.blob = None
         self.consensus = None
         self.epoch = -1
+        self.config: dict = {}  # dyncfg snapshot from CreateInstance
         # dataflow_id -> dict(df, source_shards, frontier)  (whole-replica mode)
         self.dataflows: dict[str, dict] = {}
         # whole-replica shared-trace registry (sharded mode keeps one per
@@ -161,10 +169,19 @@ class ClusterState:
         if isinstance(cmd, p.CreateInstance):
             self.blob = FileBlob(cmd.blob_path)
             self.consensus = FileConsensus(cmd.consensus_path)
-            cfg = cmd.config or {}
+            cfg = self.config = dict(cmd.config or {})
             if "ctp_max_frame_bytes" in cfg:
                 p.set_max_frame_bytes(cfg["ctp_max_frame_bytes"])
+            TRACER.set_filter(cfg.get("log_filter", "off"))
+            # profiler config rides the dyncfg snapshot too: the fused ticks
+            # whose device time matters run HERE, not at the coordinator
+            obs_profiler.configure(
+                bool(cfg.get("enable_jax_profiler", False)),
+                str(cfg.get("jax_profiler_dir", "")),
+            )
             return p.Frontiers({})
+        if isinstance(cmd, p.FetchStats):
+            return self._fetch_stats()
         if self._mesh_naive() and isinstance(
             cmd, (p.CreateDataflow, p.ProcessTo, p.AllowCompaction, p.Peek)
         ):
@@ -232,6 +249,17 @@ class ClusterState:
             ShardWorker(base + i, self.mesh, self)
             for i in range(cmd.workers_per_process)
         ]
+        # observability identity follows the mesh: spans record which shard
+        # produced them, log lines carry (shard, epoch), and the per-operator
+        # accumulators are epoch-scoped (workers and their Dataflows were
+        # just rebuilt, so the counters restart with the new generation)
+        TRACER.set_process(f"shard{cmd.process_index}")
+        obs_log.set_context(shard=cmd.process_index, epoch=cmd.epoch)
+        _log.info(
+            "mesh formed",
+            n_processes=cmd.n_processes,
+            workers=cmd.workers_per_process,
+        )
         return p.MeshReady(cmd.epoch, self.mesh.n_workers)
 
     def _create_dataflow(self, cmd: p.CreateDataflow):
@@ -244,7 +272,12 @@ class ClusterState:
         cmd.desc.as_of = cmd.as_of
         try:
             df = Dataflow(
-                cmd.desc, traces=self.traces, trace_reader=cmd.dataflow_id
+                cmd.desc,
+                traces=self.traces,
+                trace_reader=cmd.dataflow_id,
+                operator_logging=bool(
+                    self.config.get("enable_operator_logging", False)
+                ),
             )
         except Exception:
             self.traces.rollback_install(cmd.dataflow_id)
@@ -253,6 +286,7 @@ class ClusterState:
             "df": df,
             "source_shards": dict(cmd.source_shards),
             "frontier": cmd.as_of,
+            "as_of": cmd.as_of,
         }
         self.dataflows[cmd.dataflow_id] = st
         try:
@@ -306,6 +340,9 @@ class ClusterState:
                 shard=shard_ctx,
                 traces=w.traces,
                 trace_reader=cmd.dataflow_id,
+                operator_logging=bool(
+                    self.config.get("enable_operator_logging", False)
+                ),
             )
             snaps = {}
             for gid, batch_parts in snaps_parts.items():
@@ -427,6 +464,10 @@ class ClusterState:
             return p.Frontiers(self._uppers())
 
         def advance(w: ShardWorker):
+            with TRACER.span(f"worker{w.global_index}:process_to"):
+                return _advance(w)
+
+        def _advance(w: ShardWorker):
             # Tick-major across dataflows (every dataflow still steps EVERY
             # tick in its [lo, upper) — the exchanges are how peers learn a
             # timestamp is closed): shared traces on this worker require no
@@ -481,9 +522,12 @@ class ClusterState:
                 )
 
             def peek(w: ShardWorker):
-                return w.dataflows[cmd.dataflow_id]["df"].peek(
-                    cmd.index_id, at=cmd.at
-                )
+                # worker threads have no thread-local span: this parents
+                # under the adopted clusterd command span (obs/spans.py)
+                with TRACER.span(f"worker{w.global_index}:peek"):
+                    return w.dataflows[cmd.dataflow_id]["df"].peek(
+                        cmd.index_id, at=cmd.at
+                    )
 
             try:
                 parts = _run_on_workers(self.workers, peek)
@@ -505,6 +549,56 @@ class ClusterState:
         if self.sharded:
             return {k: st["frontier"] for k, st in self.sharded_dataflows.items()}
         return {k: st["frontier"] for k, st in self.dataflows.items()}
+
+    def _fetch_stats(self) -> p.StatsReport:
+        """Merge this process's introspection stats across its local workers
+        (sum elapsed/invocations/rows per operator, sum partitioned
+        arrangement sizes) — the per-process half of the partitioned-peek-
+        style merge the coordinator finishes across shard processes. Safe to
+        read worker Dataflows directly: commands are serialized under the
+        handler lock and no worker job is in flight here."""
+        operators: dict = {}
+        arrangements: dict = {}
+
+        def add_df(df_id: str, df) -> None:
+            for obj, op_i, typ, elapsed, inv in df.operator_info():
+                cur = operators.setdefault((df_id, obj, op_i, typ), [0] * 5)
+                cur[0] += int(elapsed)
+                cur[1] += int(inv)
+            for obj, op_i, typ, rin, rout, retries in df.operator_rates():
+                cur = operators.setdefault((df_id, obj, op_i, typ), [0] * 5)
+                cur[2] += int(rin)
+                cur[3] += int(rout)
+                cur[4] += int(retries)
+            for obj, op_i, name, nb, cap, rec, b in df.arrangement_info():
+                cur = arrangements.setdefault((df_id, obj, op_i, name), [0] * 4)
+                cur[0] += int(nb)
+                cur[1] += int(cap)
+                cur[2] += int(rec)
+                cur[3] += int(b)
+
+        dataflows = []
+        if self.sharded:
+            procname = f"shard{self.mesh.process_index}"
+            for w in self.workers:
+                for df_id, wst in w.dataflows.items():
+                    add_df(df_id, wst["df"])
+            for df_id, st in self.sharded_dataflows.items():
+                dataflows.append((df_id, int(st["frontier"]), int(st["as_of"])))
+        else:
+            procname = "clusterd"
+            for df_id, st in self.dataflows.items():
+                add_df(df_id, st["df"])
+                dataflows.append(
+                    (df_id, int(st["frontier"]), int(st.get("as_of", 0)))
+                )
+        return p.StatsReport(
+            procname,
+            tuple(k + tuple(v) for k, v in operators.items()),
+            tuple(k + tuple(v) for k, v in arrangements.items()),
+            tuple(dataflows),
+            obs_metrics.REGISTRY.snapshot(),
+        )
 
 
 def _cols_to_batch(col_dicts, advance_to) -> UpdateBatch:
@@ -539,13 +633,18 @@ def serve(host: str, port: int, mesh_port: int | None = None):
     lock = threading.Lock()
     if mesh_port is not None:
         state.mesh = WorkerMesh(host, mesh_port)
+    # this process serves remote controllers: completed spans of traced
+    # commands queue for shipment on the TracedResponse instead of rotting
+    # in a ring buffer nobody in this process reads
+    TRACER.set_shipping(True)
+    TRACER.set_process(f"clusterd:{port}")
     srv = socket.create_server((host, port), reuse_port=False)
     srv.listen(4)
     # listener hygiene: accept() in this sandbox is not interrupted by a
     # listener close, so the loop must wake on a timeout to observe shutdown
     # (here: the closed socket raising OSError on the next accept call)
     srv.settimeout(1.0)
-    print(f"clusterd listening on {host}:{port}", flush=True)
+    _log.info("listening", host=host, port=port)
 
     def ident():
         """Fault-injection identity: known only once the mesh is formed (so
@@ -562,8 +661,25 @@ def serve(host: str, port: int, mesh_port: int | None = None):
                 cmd = p.recv_frame(conn, link=("ctl", me) if me else None)
                 if cmd is None:
                     break
-                with lock:
-                    resp = state.handle(cmd)
+                ctx = None
+                if isinstance(cmd, p.Traced):
+                    ctx, cmd = cmd.ctx, cmd.cmd
+                if ctx is not None:
+                    # dispatch under a command span parented by the remote
+                    # context; worker jobs adopt the command span as THEIR
+                    # parent, and everything completed ships back on the
+                    # response envelope
+                    with TRACER.adopt_scope(ctx):
+                        with TRACER.span(
+                            f"clusterd:{type(cmd).__name__}"
+                        ) as sp:
+                            with TRACER.adopt_scope((ctx[0], sp.id)):
+                                with lock:
+                                    resp = state.handle(cmd)
+                    resp = p.TracedResponse(TRACER.drain_pending(), resp)
+                else:
+                    with lock:
+                        resp = state.handle(cmd)
                 me = ident()
                 p.send_frame(conn, resp, link=(me, "ctl") if me else None)
         except (ConnectionError, OSError):
@@ -593,14 +709,16 @@ def main() -> None:
     )
     ap.add_argument("--cpu", action="store_true", help="force CPU jax (tests)")
     args = ap.parse_args()
+    # subprocess logs default to info (the listening line, mesh formation)
+    # unless the operator's MZT_LOG spec already chose levels
+    if not os.environ.get("MZT_LOG"):
+        obs_log.set_default_level("info")
     # chaos tests: adopt the spawning process's seeded fault schedule so the
     # shard mesh runs under the same deterministic network simulation
     from . import faults
 
     faults.install_from_env()
     if args.cpu:
-        import os
-
         os.environ["JAX_PLATFORMS"] = "cpu"
         try:
             import jax

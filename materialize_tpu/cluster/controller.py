@@ -26,6 +26,8 @@ import time
 import uuid as uuidlib
 
 from . import protocol as p
+from ..obs import metrics as obs_metrics
+from ..obs.spans import TRACER
 
 # Per-command deadlines (seconds): how long a single request may wait for its
 # response before the connection is declared dead. Tick-driving commands can
@@ -40,7 +42,16 @@ DEFAULT_DEADLINES = {
     p.ProcessTo: 900.0,
     p.Ping: 5.0,
     p.FormMesh: 120.0,
+    p.FetchStats: 30.0,
 }
+
+# Last heartbeat round-trip per replica/shard connection — the mesh-health
+# signal /metrics exposes alongside the liveness state machine's events.
+_HEARTBEAT_RTT = obs_metrics.REGISTRY.gauge(
+    "mzt_heartbeat_rtt_seconds",
+    "last controller-to-replica heartbeat round-trip time",
+    labels=("target",),
+)
 
 # Commands safe to re-send after a reconnect/reform: replaying them against
 # state that already absorbed them is a no-op (ProcessTo below the frontier,
@@ -134,13 +145,22 @@ class ReplicaClient:
         self.close()  # ... or on final failure
         raise ConnectionError(f"cannot reach replica {self.addr}: {last}")
 
-    def request(self, cmd, timeout: float | None = None):
+    def request(self, cmd, timeout: float | None = None,
+                ctx: tuple | None = None):
         """Send one command and return its response, under a per-command
         deadline (DEFAULT_DEADLINES by type unless `timeout` overrides). A
         missed deadline surfaces as ConnectionError — the caller closes the
-        (possibly desynced) connection and re-dials before retrying."""
+        (possibly desynced) connection and re-dials before retrying.
+
+        When the calling thread is inside a trace (or `ctx` carries one
+        captured on the caller's behalf — see _request_all, which fans out on
+        worker threads), the command rides a Traced envelope and the remote
+        process's completed spans are absorbed from the TracedResponse."""
         if timeout is None:
             timeout = self.deadlines.get(type(cmd))
+        if ctx is None:
+            ctx = TRACER.current_context()
+        wire = cmd if ctx is None else p.Traced(ctx, cmd)
         with self.lock:
             sock = self.sock
             if sock is None:
@@ -148,11 +168,16 @@ class ReplicaClient:
             try:
                 if timeout is not None:
                     sock.settimeout(timeout)
-                p.send_frame(sock, cmd, link=("ctl", self.label))
+                p.send_frame(sock, wire, link=("ctl", self.label))
                 while True:
                     resp = p.recv_frame(sock, link=(self.label, "ctl"))
                     if resp is None:
                         raise ConnectionError(f"replica {self.addr} hung up")
+                    if isinstance(resp, p.TracedResponse):
+                        # absorb remote spans BEFORE the stale-response
+                        # checks below inspect the payload
+                        TRACER.absorb(resp.spans)
+                        resp = resp.resp
                     if isinstance(resp, p.PeekResponse) and (
                         not isinstance(cmd, p.Peek) or resp.uuid != cmd.uuid
                     ):
@@ -197,6 +222,9 @@ class ReplicaClient:
                     resp = p.recv_frame(sock, link=(self.label, "ctl"))
                     if resp is None:
                         return None
+                    if isinstance(resp, p.TracedResponse):
+                        TRACER.absorb(resp.spans)
+                        resp = resp.resp
                     if isinstance(resp, p.Pong):
                         return resp
                     if isinstance(resp, p.PeekResponse):
@@ -340,6 +368,24 @@ class ComputeController:
                 last_err = resp.error
         raise RuntimeError(last_err or "no live replicas for peek")
 
+    def fetch_stats(self) -> list:
+        """Pull one replica's introspection stats (FetchStats). Replicas are
+        identical active-active copies, so the first healthy answer is
+        representative; fail-soft — an unreachable cluster yields []."""
+        for i in range(len(self.addrs)):
+            r = self._ensure_replica(i)
+            if r is None:
+                continue
+            try:
+                resp = r.request(p.FetchStats())
+            except (ConnectionError, OSError):
+                r.close()
+                self.replicas[i] = None
+                continue
+            if isinstance(resp, p.StatsReport):
+                return [resp]
+        return []
+
     # -- liveness --------------------------------------------------------------
     def start_heartbeats(self, interval: float = 2.0) -> None:
         """Proactive liveness: ping every connected replica on a timer so a
@@ -375,12 +421,14 @@ class ComputeController:
                 alive.append(False)
                 continue
             try:
+                t0 = time.perf_counter()
                 resp = r.request(p.Ping())
                 ok = isinstance(resp, p.Pong)
             except (ConnectionError, OSError):
                 ok = False
             if ok:
                 self.last_pong[i] = time.time()
+                _HEARTBEAT_RTT.set(time.perf_counter() - t0, target=r.label)
             else:
                 r.close()
                 # compare-and-clear: the command thread may have already
@@ -603,6 +651,9 @@ class ShardedComputeController:
         commands meet at mesh exchanges and MUST overlap)."""
         resps: list = [None] * self.n_processes
         errs: list = [None] * self.n_processes
+        # trace context is thread-local: capture it HERE (the statement's
+        # thread) so the per-shard request threads propagate the right parent
+        ctx = TRACER.current_context()
 
         def run(i: int) -> None:
             r = self.shards[i]
@@ -610,7 +661,7 @@ class ShardedComputeController:
                 errs[i] = ConnectionError(f"shard {i} not connected")
                 return
             try:
-                resps[i] = r.request(cmds[i])
+                resps[i] = r.request(cmds[i], ctx=ctx)
             except (ConnectionError, OSError) as e:
                 errs[i] = e
                 # a failed/timed-out request leaves the stream desynced (its
@@ -766,6 +817,20 @@ class ShardedComputeController:
             f"peek {index_id} failed after {attempts} attempt(s): {last}"
         )
 
+    def fetch_stats(self) -> list:
+        """Pull every shard's introspection stats — state is partitioned, so
+        the coordinator merges the per-shard StatsReports like partitioned
+        peeks. Fail-soft: a degraded/unreachable replica yields [] rather
+        than driving a reform over an introspection read."""
+        if self.degraded:
+            return []
+        try:
+            with self._cmd_lock:
+                resps = self._request_all([p.FetchStats()] * self.n_processes)
+        except (ConnectionError, OSError):
+            return []
+        return [resp for resp in resps if isinstance(resp, p.StatsReport)]
+
     # -- liveness ----------------------------------------------------------
     def start_heartbeats(self, interval: float = 2.0) -> None:
         """Proactive per-shard liveness (the CTP connection heartbeats,
@@ -814,6 +879,7 @@ class ShardedComputeController:
                 except (ConnectionError, OSError):
                     pass
             else:
+                t0 = time.perf_counter()
                 pong = r.try_ping(self.deadlines.get(p.Ping, 5.0)
                                   if self.deadlines else 5.0)
                 if pong == "busy":
@@ -831,6 +897,7 @@ class ShardedComputeController:
             if ok:
                 self._misses[i] = 0
                 self.last_pong[i] = time.time()
+                _HEARTBEAT_RTT.set(time.perf_counter() - t0, target=r.label)
             else:
                 self._misses[i] += 1
             alive.append(ok)

@@ -38,6 +38,30 @@ import threading
 from typing import Optional
 
 from . import protocol as p
+from ..obs import metrics as obs_metrics
+
+# Cross-process exchange traffic (remote sends only — a local hand-off costs
+# no wire bytes). Scraped via /metrics from the coordinator's in-process mesh
+# and shipped from clusterd in StatsReport.counters.
+_EXCHANGE_FRAMES = obs_metrics.REGISTRY.counter(
+    "mzt_mesh_exchange_frames_total",
+    "data frames sent to remote shard processes",
+)
+_EXCHANGE_BYTES = obs_metrics.REGISTRY.counter(
+    "mzt_mesh_exchange_bytes_total",
+    "column-payload bytes sent to remote shard processes",
+)
+
+
+def _part_nbytes(part) -> int:
+    """Payload bytes of one exchange part (a column dict of numpy arrays,
+    or None for empty punctuation)."""
+    if not part:
+        return 0
+    try:
+        return int(sum(v.nbytes for v in part.values()))
+    except AttributeError:
+        return 0
 
 # wire frames (length-prefixed pickles, protocol.py framing)
 #   ("hello", epoch, from_process)        handshake, dialer -> acceptor
@@ -370,6 +394,8 @@ class WorkerMesh:
             try:
                 with slock:
                     p.send_frame(sock, frame, link=self._link(proc))
+                _EXCHANGE_FRAMES.inc()
+                _EXCHANGE_BYTES.inc(_part_nbytes(parts[dst]))
             except (OSError, ConnectionError) as e:
                 # partial send: peers before `proc` already hold our part for
                 # this tick and would stall waiting for the rest — poison the

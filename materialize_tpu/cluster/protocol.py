@@ -10,8 +10,12 @@ Commands:  CreateInstance, CreateDataflow, AllowCompaction, Peek, ProcessTo,
            Hello (epoch handshake — stale generations are fenced, the
            communication.rs:253 epoch-fencing analogue),
            FormMesh (sharded data plane: join the epoch-fenced worker mesh
-           as one shard process of a multi-process replica, cluster/mesh.py)
-Responses: Frontiers, PeekResponse, Error, Pong, MeshReady
+           as one shard process of a multi-process replica, cluster/mesh.py),
+           FetchStats (introspection pull: per-process operator/arrangement
+           stats merged at the coordinator like partitioned peeks),
+           Traced (envelope: any command + a span context — obs/spans.py)
+Responses: Frontiers, PeekResponse, Error, Pong, MeshReady, StatsReport,
+           TracedResponse (envelope: any response + completed remote spans)
 """
 
 from __future__ import annotations
@@ -174,6 +178,27 @@ class Ping:
 
 
 @dataclass(frozen=True)
+class Traced:
+    """Envelope carrying a span context with any command: `ctx` is
+    (trace_id, parent_span_id) minted by the frontend's statement trace.
+    clusterd unwraps, adopts the context for the dispatch, and answers with
+    a TracedResponse carrying its completed spans — the W3C-traceparent
+    analogue for CTP (obs/spans.py)."""
+
+    ctx: tuple  # (trace_id, parent_span_id)
+    cmd: Any
+
+
+@dataclass(frozen=True)
+class FetchStats:
+    """Pull this process's introspection stats (operator accumulators,
+    arrangement sizes, dataflow frontiers, obs-registry counters) — the
+    coordinator merges per-shard reports like partitioned peeks."""
+
+    pass
+
+
+@dataclass(frozen=True)
 class FormMesh:
     """(Re)form the sharded worker mesh at `epoch`: this process hosts
     `workers_per_process` workers as shard `process_index` of `n_processes`.
@@ -227,3 +252,32 @@ class Pong:
 class MeshReady:
     epoch: int
     n_workers: int
+
+
+@dataclass(frozen=True)
+class TracedResponse:
+    """Response envelope for a Traced command: `spans` are the remote
+    process's completed spans for shipping back into the caller's ring."""
+
+    spans: tuple  # of obs.spans.Span
+    resp: Any
+
+
+@dataclass(frozen=True)
+class StatsReport:
+    """One process's introspection snapshot (FetchStats response), merged
+    across that process's local workers already.
+
+    operators:     ((dataflow_id, obj_id, op_idx, type, elapsed_ns,
+                     invocations, rows_in, rows_out, retries), ...)
+    arrangements:  ((dataflow_id, obj_id, op_idx, name, batches, capacity,
+                     records, bytes), ...)
+    dataflows:     ((dataflow_id, frontier, as_of), ...) — hydration status
+    counters:      obs.metrics Registry.snapshot() of the remote process
+    """
+
+    process: str
+    operators: tuple = ()
+    arrangements: tuple = ()
+    dataflows: tuple = ()
+    counters: tuple = ()

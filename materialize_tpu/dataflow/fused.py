@@ -361,7 +361,13 @@ class FusedCompiler:
         hit = ctx.memo.get(id(e))
         if hit is not None:
             return hit
-        out = self._emit_new(e, ctx)
+        from ..obs import profiler as _prof
+
+        # named scope at TRACE time: HLO ops carry the plan-node name, so a
+        # jax.profiler TPU trace attributes device time to operators; a
+        # module-bool no-op when the profiler dyncfg is off
+        with _prof.named_scope(f"mzt:{type(e).__name__}"):
+            out = self._emit_new(e, ctx)
         ctx.memo[id(e)] = out
         return out
 
@@ -723,6 +729,7 @@ class FusedDataflow:
         mesh=None,
         axis_name: str = "workers",
         traces=None,
+        operator_logging: bool = False,
     ):
         # `traces`: the host TraceManager, when arrangement sharing is on.
         # Fused state is device-resident and cannot import a host spine, so
@@ -755,6 +762,19 @@ class FusedDataflow:
         self.since = 0
         self._emitted_consts: set[str] = set()
         self.metrics: dict = {}
+        self.operator_logging = operator_logging
+        # the whole tick is one program, so instrumentation is per-dataflow:
+        # elapsed/invocations always on, row counts gated, and `retries`
+        # counts overflow-ladder escalations (mz_dataflow_operator_rates)
+        self.retries = 0
+        self._elapsed_ns = 0
+        self._invocations = 0
+        self._rows_in = 0
+        self._rows_out = 0
+        self._profile_name = next(
+            iter(desc.index_exports),
+            next(iter(b.id for b in desc.objects_to_build), "fused"),
+        )
 
     # -- compile ------------------------------------------------------------
     def _build(self) -> None:
@@ -854,6 +874,7 @@ class FusedDataflow:
             return
         while self._delta_cap() < n_rows:
             self._scale *= 2
+        self.retries += 1
         self._build()
         self._migrate_state()
 
@@ -883,14 +904,21 @@ class FusedDataflow:
 
     # -- drive --------------------------------------------------------------
     def step(self, tick: int, source_deltas: dict[str, UpdateBatch]) -> dict:
+        import time as _time
+
+        from ..obs import profiler as _prof
+
+        t0 = _time.perf_counter_ns()
         delta_cap = self._delta_cap()
         deltas: dict[str, UpdateBatch] = {}
+        rows_in = 0
         for sid, dts in self.desc.source_imports.items():
             b = source_deltas.get(sid)
             if b is None:
                 deltas[sid] = UpdateBatch.empty(delta_cap, (), tuple(dts))
             else:
                 n = int(b.count())
+                rows_in += n
                 if n > delta_cap:
                     # oversized input tick: grow + recompile before trying
                     self.ensure_delta_capacity(n)
@@ -899,12 +927,15 @@ class FusedDataflow:
         for cid, c in self.consts.items():
             deltas[cid] = self._const_delta(cid, c, tick, delta_cap)
 
-        state2, outs, errs, over, counts = self._tick(
-            self.state, deltas, device_time_scalar(tick), device_time_scalar(self.since)
-        )
+        with _prof.annotate(f"mzt_fused_tick:{self._profile_name}"):
+            state2, outs, errs, over, counts = self._tick(
+                self.state, deltas, device_time_scalar(tick), device_time_scalar(self.since)
+            )
         if bool(np.asarray(over).any()):
             # lossless retry: drop results, double capacities, re-run the
             # same tick from the unchanged pre-tick state
+            self.retries += 1
+            self._elapsed_ns += _time.perf_counter_ns() - t0
             self._scale *= 2
             self._build()
             self._migrate_state()
@@ -935,6 +966,11 @@ class FusedDataflow:
             d = results.get(obj_id)
             if d is not None and d[0] is not None:
                 self.sink_outputs[sink_id].append((tick, d[0]))
+        self._elapsed_ns += _time.perf_counter_ns() - t0
+        self._invocations += 1
+        if self.operator_logging:
+            self._rows_in += rows_in
+            self._rows_out += int(counts[:-1].sum())
         self.frontier = tick + 1
         return results
 
@@ -982,9 +1018,24 @@ class FusedDataflow:
             arr.compact(since)
 
     def operator_info(self) -> list:
-        return []
+        # one fused program per tick: a single pseudo-operator carries the
+        # whole dataflow's elapsed/invocations (same 5-tuple shape as the
+        # host renderer's per-operator rows)
+        return [("fused", 0, "FusedTick", self._elapsed_ns, self._invocations)]
+
+    def operator_rates(self) -> list:
+        return [
+            ("fused", 0, "FusedTick", self._rows_in, self._rows_out, self.retries)
+        ]
 
     def arrangement_info(self) -> list:
+        from .runtime import accum_state_nbytes, arrangement_nbytes, batch_nbytes
+
+        def _leaves_nbytes(st):
+            if isinstance(st, LsmBatches):
+                return sum(batch_nbytes(b) for b in st.levels)
+            return sum(accum_state_nbytes(a) for a in st.levels)
+
         out = []
         for path, st in self.state.items():
             if isinstance(st, LsmBatches):
@@ -993,5 +1044,29 @@ class FusedDataflow:
             else:
                 n = sum(int(a.count()) for a in st.levels)
                 cap = sum(a.cap for a in st.levels)
-            out.append(("fused", 0, path, len(st.levels), cap, n))
+            out.append(("fused", 0, path, len(st.levels), cap, n, _leaves_nbytes(st)))
+        for idx_id, arr in self.index_traces.items():
+            out.append(
+                (
+                    idx_id,
+                    -1,
+                    "index_trace",
+                    len(arr.batches),
+                    arr.total_cap(),
+                    int(arr.count()),
+                    arrangement_nbytes(arr),
+                )
+            )
+        for idx_id, arr in self.index_errs.items():
+            out.append(
+                (
+                    idx_id,
+                    -1,
+                    "index_errs",
+                    len(arr.batches),
+                    arr.total_cap(),
+                    int(arr.count()),
+                    arrangement_nbytes(arr),
+                )
+            )
         return out

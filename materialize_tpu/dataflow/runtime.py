@@ -262,6 +262,51 @@ def _shared_state_info(h) -> tuple:
     return nb, cap, rec
 
 
+# -- arrangement byte accounting (the id-deduped scheme shared with
+#    benchmarks/bench_shared_mvs.py: owners charge, importers report zero) ---
+
+
+def batch_nbytes(b) -> int:
+    n = 0
+    for attr in ("hashes", "times", "diffs"):
+        v = getattr(b, attr, None)
+        if v is not None:
+            n += int(getattr(v, "nbytes", 0))
+    for attr in ("keys", "vals"):
+        for col in getattr(b, attr, ()) or ():
+            n += int(getattr(col, "nbytes", 0))
+    return n
+
+
+def arrangement_nbytes(arr) -> int:
+    return sum(batch_nbytes(b) for b in arr.batches)
+
+
+def accum_state_nbytes(st) -> int:
+    n = 0
+    for attr in ("hashes", "times"):
+        v = getattr(st, attr, None)
+        if v is not None:
+            n += int(getattr(v, "nbytes", 0))
+    for attr in ("keys", "accums", "vals"):
+        for col in getattr(st, attr, ()) or ():
+            n += int(getattr(col, "nbytes", 0))
+    return n
+
+
+def _shared_handle_nbytes(h) -> int:
+    """Bytes to report for a shared trace handle: importers 0 (the exporter
+    owns the memory), exporters the trace's arrangement (SharedTrace) or
+    accumulator + output arrangement (SharedReduceTrace)."""
+    if h.imported:
+        return 0
+    tr = h.trace
+    arr = getattr(tr, "arr", None)
+    if arr is not None:
+        return arrangement_nbytes(arr)
+    return accum_state_nbytes(tr.state) + arrangement_nbytes(tr.out_arr)
+
+
 class SharedArrangeNode(Node):
     """ArrangeBy over a shared trace: pass the delta through, offering it to
     the trace (one LSM insert per tick TOTAL across every reader — the
@@ -839,6 +884,9 @@ class DistinctNode(Node):
         )
         return out, _union([errs, coll])
 
+    def state_info(self):
+        return [("distinct_accums", 1, self.state.cap, int(self.state.count()))]
+
 
 class ThresholdNode(Node):
     def __init__(self, in_dtypes: tuple):
@@ -853,6 +901,9 @@ class ThresholdNode(Node):
             return None if errs is None else (None, errs)
         self.state, out, coll = threshold_step(self.state, oks, "threshold", tick)
         return out, _union([errs, coll])
+
+    def state_info(self):
+        return [("threshold_accums", 1, self.state.cap, int(self.state.count()))]
 
 
 class TopKNode(Node):
@@ -873,6 +924,11 @@ class TopKNode(Node):
 
     def compact(self, since):
         self.arr.compact(since)
+
+    def state_info(self):
+        return [
+            ("topk_input", len(self.arr.batches), self.arr.total_cap(), self.arr.count())
+        ]
 
 
 class WindowNode(Node):
@@ -1163,6 +1219,12 @@ class LetRecNode(Node):
             return None
         return out, errs
 
+    def state_info(self):
+        return [
+            (f"letrec:{name}", nb, cap, rec)
+            for _obj, _op, name, nb, cap, rec, _b in self.inner.arrangement_info()
+        ]
+
 
 def peek_row_key(row: tuple) -> tuple:
     """THE canonical peek output order (NULLs last per column). Every reader
@@ -1255,6 +1317,42 @@ def _retime(batch: UpdateBatch, tick: int) -> UpdateBatch:
 # ---------------------------------------------------------------------------
 
 
+def _node_state_bytes(node, rows: list) -> list:
+    """Per-state_info-row byte counts for one node, aligned with `rows`
+    (its state_info() output). Dispatch mirrors bench_shared_mvs.py's
+    _state_objects: owners charge their arrangements/accumulators, shared
+    importers charge zero."""
+    if isinstance(node, ArrangeByNode):
+        return [arrangement_nbytes(node.arr)]
+    if isinstance(node, (SharedArrangeNode, SharedReduceNode)):
+        return [_shared_handle_nbytes(node.h)]
+    if isinstance(node, LinearJoinNode):
+        out = []
+        for (l, r), (lh, rh) in zip(node.state, node.shared):
+            out.append(arrangement_nbytes(l) if l is not None else _shared_handle_nbytes(lh))
+            out.append(arrangement_nbytes(r) if r is not None else _shared_handle_nbytes(rh))
+        return out
+    if isinstance(node, DeltaJoinNode):
+        return [arrangement_nbytes(a) for a in node.arrs.values()] + [
+            _shared_handle_nbytes(h) for h in node.shared.values()
+        ]
+    if isinstance(node, (ReduceNode, FusedMfpReduceNode, DistinctNode, ThresholdNode)):
+        return [accum_state_nbytes(node.state)]
+    if isinstance(node, BasicAggNode):
+        # (groups, rendered_bytes) rows: host dicts are uncharged, the
+        # rendered-bytes row's record count IS its byte figure
+        return [0] + [r[3] for r in rows[1:]]
+    if isinstance(node, (WindowNode, TopKNode)):
+        return [arrangement_nbytes(node.arr)]
+    if isinstance(node, MonotonicTopKNode):
+        return [arrangement_nbytes(node.out_arr)]
+    if isinstance(node, TemporalFilterNode):
+        return [0 if node.pending is None else batch_nbytes(node.pending)]
+    if isinstance(node, LetRecNode):
+        return [b for *_rest, b in node.inner.arrangement_info()]
+    return [0] * len(rows)
+
+
 @dataclass
 class _Rendered:
     node: Node
@@ -1276,6 +1374,7 @@ class Dataflow:
         traces=None,
         trace_reader: str | None = None,
         trace_export: bool = True,
+        operator_logging: bool = False,
     ):
         # `shard`: render as ONE worker of a multi-process sharded replica —
         # exchange pacts are inserted in front of every stateful operator and
@@ -1323,8 +1422,12 @@ class Dataflow:
             else EMPTY
         )
         # (obj_id, op_idx) -> {type, elapsed_ns, invocations}; the analogue of
-        # the reference's timely/compute introspection logs (SURVEY.md §5)
+        # the reference's timely/compute introspection logs (SURVEY.md §5).
+        # elapsed/invocations are always on (two perf_counter reads per
+        # operator dispatch); rows in/out need a device sync per delta, so
+        # they are gated by `operator_logging` (enable_operator_logging)
         self.metrics: dict = {}
+        self.operator_logging = operator_logging
         # cooperative cancellation: when set (ephemeral peek dataflows), this
         # callable runs between operator dispatches and raises QueryCanceled
         # once the statement's deadline passed or a CancelRequest landed —
@@ -1375,13 +1478,66 @@ class Dataflow:
                 )
         return out
 
-    def arrangement_info(self) -> list:
-        """[(obj_id, op_idx, name, batches, capacity, records)]."""
+    def operator_rates(self) -> list:
+        """[(obj_id, op_idx, type, rows_in, rows_out, retries)] — row counts
+        populate only while `operator_logging` is on (zeros otherwise);
+        retries are the fused path's overflow-ladder escalations (always 0
+        on the host path, which never re-runs an operator)."""
         out = []
         for obj_id, ops, _ref in self.builds:
             for op_i, (node, _ins) in enumerate(ops):
-                for name, nb, cap, rec in node.state_info():
-                    out.append((obj_id, op_i, name, nb, cap, int(rec)))
+                m = self.metrics.get((obj_id, op_i), {})
+                out.append(
+                    (
+                        obj_id,
+                        op_i,
+                        type(node).__name__,
+                        m.get("rows_in", 0),
+                        m.get("rows_out", 0),
+                        m.get("retries", 0),
+                    )
+                )
+        return out
+
+    def arrangement_info(self) -> list:
+        """[(obj_id, op_idx, name, batches, capacity, records, bytes)].
+
+        Bytes follow the id-deduped owner-charges accounting (see
+        batch_nbytes and friends above): a trace shared across dataflows
+        contributes its memory exactly once to the cross-dataflow sum.
+        Index export traces report as pseudo-operators at op_idx -1.
+        """
+        out = []
+        for obj_id, ops, _ref in self.builds:
+            for op_i, (node, _ins) in enumerate(ops):
+                rows = node.state_info()
+                nbytes = _node_state_bytes(node, rows)
+                for (name, nb, cap, rec), b in zip(rows, nbytes):
+                    out.append((obj_id, op_i, name, nb, cap, int(rec), int(b)))
+        for idx_id, arr in self.index_traces.items():
+            out.append(
+                (
+                    idx_id,
+                    -1,
+                    "index_trace",
+                    len(arr.batches),
+                    arr.total_cap(),
+                    int(arr.count()),
+                    arrangement_nbytes(arr),
+                )
+            )
+        for idx_id, arr in self.index_errs.items():
+            out.append(
+                (
+                    idx_id,
+                    -1,
+                    "index_errs",
+                    len(arr.batches),
+                    arr.total_cap(),
+                    int(arr.count()),
+                    arrangement_nbytes(arr),
+                )
+            )
         return out
 
     # -- rendering ---------------------------------------------------------
@@ -1710,6 +1866,21 @@ class Dataflow:
                 )
                 m["elapsed_ns"] += _time.perf_counter_ns() - t0
                 m["invocations"] += 1
+                if self.operator_logging:
+                    # row counts need a device sync per delta — gated so the
+                    # default tick path does no per-row work (the
+                    # enable_operator_logging zero-overhead contract)
+                    rin = sum(
+                        int(d[0].count()) for d in ins if d is not None and d[0] is not None
+                    )
+                    out_d = slots[-1]
+                    rout = (
+                        int(out_d[0].count())
+                        if out_d is not None and out_d[0] is not None
+                        else 0
+                    )
+                    m["rows_in"] = m.get("rows_in", 0) + rin
+                    m["rows_out"] = m.get("rows_out", 0) + rout
             out = env.get(out_ref) if isinstance(out_ref, str) else slots[out_ref]
             if self.until and out is not None:
                 out = (

@@ -147,58 +147,112 @@ class SqlHandler(BaseHTTPRequestHandler):
         return self._reply(404, {"error": "not found"})
 
     def _metrics_text(self) -> str:
-        """Prometheus text exposition of coordinator/dataflow metrics
-        (reference: mz_ore::metrics registries, src/compute/src/metrics.rs)."""
-        c = self.coordinator
-        lines = [
-            "# TYPE mzt_oracle_read_ts gauge",
-            f"mzt_oracle_read_ts {c.oracle.read_ts()}",
-            "# TYPE mzt_catalog_items gauge",
-            f"mzt_catalog_items {len(c.catalog.items)}",
-            "# TYPE mzt_dataflows gauge",
-            f"mzt_dataflows {len(c.dataflows)}",
-            "# TYPE mzt_overload_counter counter",
+        return metrics_text(self.coordinator, self.lock)
+
+
+def metrics_text(coord, lock) -> str:
+    """Prometheus text exposition of coordinator/dataflow metrics
+    (reference: mz_ore::metrics registries, src/compute/src/metrics.rs).
+
+    Scrape-time values are *gathered* under ``lock`` — a fast pass copying
+    numbers out of engine structures — and the text is rendered outside it,
+    so a slow scrape never stalls the coordinator command loop. Replica
+    counters ride the cached StatsReports (introspection_interval_s), fetched
+    before the lock is taken.
+    """
+    from ..obs.metrics import REGISTRY, Snapshot
+
+    reports = coord.replica_stats() if hasattr(coord, "replica_stats") else []
+    with lock:
+        oracle_ts = coord.oracle.read_ts()
+        n_items = len(coord.catalog.items)
+        n_dataflows = len(coord.dataflows)
+        overload = sorted(coord.overload.snapshot().items())
+        tm = coord.trace_manager
+        shared_traces = tm.trace_count()
+        hit_rate = tm.import_hit_rate()
+        sharing = sorted(tm.stats.items())
+        depths = [
+            ((("gate", "statement"),), coord.admission.depth),
+            ((("gate", "peek"),), coord.peek_gate.depth),
         ]
-        for name, value in sorted(c.overload.snapshot().items()):
-            lines.append(f'mzt_overload_counter{{name="{name}"}} {value}')
-        tm = c.trace_manager
-        lines += [
-            "# TYPE mzt_shared_traces gauge",
-            f"mzt_shared_traces {tm.trace_count()}",
-            "# TYPE mzt_trace_import_hit_rate gauge",
-            f"mzt_trace_import_hit_rate {tm.import_hit_rate():.6f}",
-            "# TYPE mzt_trace_sharing_counter counter",
-        ]
-        for name, value in sorted(tm.stats.items()):
-            lines.append(f'mzt_trace_sharing_counter{{name="{name}"}} {value}')
-        lines += [
-            "# TYPE mzt_admission_queue_depth gauge",
-            f'mzt_admission_queue_depth{{gate="statement"}} {c.admission.depth}',
-            f'mzt_admission_queue_depth{{gate="peek"}} {c.peek_gate.depth}',
-            "# TYPE mzt_peek_duration_bucket counter",
-        ]
-        with self.lock:
-            # under the lock, and over a dict() snapshot (pgwire may hold a
-            # DIFFERENT lock): a concurrent _record_peek inserting a fresh
-            # bucket key mid-iteration would fault the scrape
-            for bucket, count in sorted(
-                dict(getattr(c, "peek_histogram", {})).items()
-            ):
-                lines.append(
-                    f'mzt_peek_duration_bucket{{le_ns="{bucket}"}} {count}'
+        # over a dict() snapshot (pgwire may hold a DIFFERENT lock): a
+        # concurrent _record_peek inserting a fresh bucket key mid-iteration
+        # would fault the scrape
+        peek_hist = sorted(dict(getattr(coord, "peek_histogram", {})).items())
+        ops, arr_recs, arr_bytes = [], [], []
+        for gid, df, _src in coord.dataflows:
+            for _obj, op_i, typ, el, _inv in df.operator_info():
+                ops.append(((("dataflow", gid), ("op", op_i), ("type", typ)), el))
+            for _obj, op_i, aname, _nb, _cap, rec, b in df.arrangement_info():
+                labels = (("dataflow", gid), ("op", op_i), ("arrangement", aname))
+                arr_recs.append((labels, rec))
+                arr_bytes.append((labels, b))
+    extras = [
+        Snapshot(
+            "mzt_oracle_read_ts", "gauge", "timestamp oracle read frontier",
+            [((), oracle_ts)],
+        ),
+        Snapshot(
+            "mzt_catalog_items", "gauge", "catalog item count", [((), n_items)]
+        ),
+        Snapshot(
+            "mzt_dataflows", "gauge", "installed dataflow count",
+            [((), n_dataflows)],
+        ),
+        Snapshot(
+            "mzt_overload_counter", "counter", "overload protection decisions",
+            [((("name", k),), v) for k, v in overload],
+        ),
+        Snapshot(
+            "mzt_shared_traces", "gauge", "traces in the shared trace manager",
+            [((), shared_traces)],
+        ),
+        Snapshot(
+            "mzt_trace_import_hit_rate", "gauge",
+            "fraction of trace imports served from a shared arrangement",
+            [((), f"{hit_rate:.6f}")],
+        ),
+        Snapshot(
+            "mzt_trace_sharing_counter", "counter", "trace sharing events",
+            [((("name", k),), v) for k, v in sharing],
+        ),
+        Snapshot(
+            "mzt_admission_queue_depth", "gauge",
+            "statements/peeks waiting at an admission gate", depths,
+        ),
+        Snapshot(
+            "mzt_peek_duration_bucket", "counter",
+            "peek latency histogram (cumulative, power-of-two ns buckets)",
+            [((("le_ns", k),), v) for k, v in peek_hist],
+        ),
+        Snapshot(
+            "mzt_operator_elapsed_ns", "counter",
+            "cumulative wall time inside each operator", ops,
+        ),
+        Snapshot(
+            "mzt_arrangement_records", "gauge",
+            "records held per arrangement", arr_recs,
+        ),
+        Snapshot(
+            "mzt_arrangement_bytes", "gauge",
+            "owner-charged bytes per arrangement (shared traces charged once)",
+            arr_bytes,
+        ),
+    ]
+    # replica-process registry snapshots (mesh exchange, persist ops, …)
+    # surface under the same family names with a `process` label; render()
+    # emits HELP/TYPE once per name even when a family spans processes
+    for replica, rep in reports:
+        proc = (("process", f"{replica}/{rep.process}"),)
+        for name, kind, help_, samples in rep.counters:
+            extras.append(
+                Snapshot(
+                    name, kind, help_,
+                    [(tuple(labels) + proc, v) for labels, v in samples],
                 )
-            lines.append("# TYPE mzt_operator_elapsed_ns counter")
-            for gid, df, _src in c.dataflows:
-                for _obj, op_i, typ, el, inv in df.operator_info():
-                    lines.append(
-                        f'mzt_operator_elapsed_ns{{dataflow="{gid}",op="{op_i}",type="{typ}"}} {el}'
-                    )
-            for gid, df, _src in c.dataflows:
-                for _obj, op_i, name, nb, cap, rec in df.arrangement_info():
-                    lines.append(
-                        f'mzt_arrangement_records{{dataflow="{gid}",op="{op_i}",arrangement="{name}"}} {rec}'
-                    )
-        return "\n".join(lines) + "\n"
+            )
+    return REGISTRY.expose(extra=extras)
 
 
 def serve(

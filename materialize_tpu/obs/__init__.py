@@ -1,0 +1,18 @@
+"""Observability: structured logging, metrics registry, cross-process spans,
+and dyncfg-gated profiling.
+
+The analogue of the reference's ops surface — `mz-ore` tracing/metrics plus
+the compute logging dataflows (src/compute/src/logging) — collapsed into one
+package the rest of the engine threads through:
+
+- ``obs.log``      per-subsystem leveled logging, configured via ``MZT_LOG``
+- ``obs.metrics``  one process-global metrics registry + Prometheus exposition
+- ``obs.spans``    the Tracer: trace/span contexts that cross CTP boundaries
+- ``obs.profiler`` dyncfg-gated jax.profiler annotation for the fused path
+
+Import discipline: this package imports nothing from the engine (only stdlib
++ optionally jax inside the profiler), so every layer — repr, persist,
+cluster, adapter, frontend — can depend on it without cycles.
+"""
+
+from . import log, metrics, spans  # noqa: F401

@@ -1,0 +1,149 @@
+"""Structured, per-subsystem leveled logging.
+
+The `RUST_LOG` analogue: ``MZT_LOG`` configures a default level and/or
+per-subsystem overrides, e.g.
+
+    MZT_LOG=debug                     # everything at debug
+    MZT_LOG=mesh=debug,persist=info   # targeted, default stays warn
+    MZT_LOG=info,mesh=debug           # default info, mesh at debug
+
+Levels (increasing severity): debug < info < warn < error; ``off`` silences a
+subsystem entirely. The default level is ``warn`` so pre-existing warning
+paths keep printing while info/debug stay quiet unless asked for.
+
+Every line carries the subsystem and any process-wide context installed with
+:func:`set_context` (clusterd sets ``shard``/``epoch`` so chaos and
+crash-matrix failures are attributable to a process), plus per-call fields::
+
+    log = get_logger("mesh")
+    log.debug("exchange stalled", channel=ch, tick=t, worker=w)
+    # -> 12:00:01.234 DEBUG mesh[shard=1 epoch=3] exchange stalled channel=7 tick=9 worker=0
+
+The level check is an int compare on a bound attribute — a disabled call
+costs one comparison, no string work.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+_LEVELS = {"debug": 10, "info": 20, "warn": 30, "warning": 30, "error": 40, "off": 99}
+_DEFAULT = "warn"
+
+_lock = threading.Lock()
+_loggers: dict[str, "Logger"] = {}
+_default_level = _LEVELS[_DEFAULT]
+_overrides: dict[str, int] = {}
+_context: dict[str, object] = {}
+
+
+def parse_spec(spec: str) -> tuple[int, dict[str, int]]:
+    """Parse an MZT_LOG spec into (default_level, {subsystem: level}).
+
+    Unknown level names fall back to the default rather than raising — a bad
+    env var must never take the engine down.
+    """
+    default = _LEVELS[_DEFAULT]
+    overrides: dict[str, int] = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" in part:
+            name, _, lvl = part.partition("=")
+            overrides[name.strip()] = _LEVELS.get(lvl.strip().lower(), default)
+        else:
+            default = _LEVELS.get(part.lower(), default)
+    return default, overrides
+
+
+def configure(spec: str | None = None) -> None:
+    """(Re)configure from an explicit spec or the MZT_LOG env var."""
+    global _default_level, _overrides
+    if spec is None:
+        spec = os.environ.get("MZT_LOG", "")
+    default, overrides = parse_spec(spec)
+    with _lock:
+        _default_level = default
+        _overrides = overrides
+        for name, lg in _loggers.items():
+            lg.level = _overrides.get(name, _default_level)
+
+
+def set_default_level(level: str) -> None:
+    """Raise/lower the default level for subsystems without an explicit
+    MZT_LOG override (clusterd runs at info so subprocess logs are useful)."""
+    global _default_level
+    with _lock:
+        _default_level = _LEVELS.get(level, _default_level)
+        for name, lg in _loggers.items():
+            if name not in _overrides:
+                lg.level = _default_level
+
+
+def set_context(**fields) -> None:
+    """Install process-wide context rendered on every line (``shard=``,
+    ``epoch=``, …). ``None`` removes a key."""
+    with _lock:
+        for k, v in fields.items():
+            if v is None:
+                _context.pop(k, None)
+            else:
+                _context[k] = v
+
+
+class Logger:
+    __slots__ = ("subsystem", "level")
+
+    def __init__(self, subsystem: str, level: int):
+        self.subsystem = subsystem
+        self.level = level
+
+    def enabled(self, level: str) -> bool:
+        return _LEVELS.get(level, 99) >= self.level
+
+    def _emit(self, lvl_num: int, lvl_name: str, msg: str, fields: dict) -> None:
+        if lvl_num < self.level:
+            return
+        t = time.time()
+        stamp = time.strftime("%H:%M:%S", time.localtime(t)) + f".{int(t * 1000) % 1000:03d}"
+        ctx = ""
+        if _context:
+            ctx = "[" + " ".join(f"{k}={v}" for k, v in _context.items()) + "]"
+        tail = ""
+        if fields:
+            tail = " " + " ".join(f"{k}={v}" for k, v in fields.items())
+        print(
+            f"{stamp} {lvl_name:<5} {self.subsystem}{ctx} {msg}{tail}",
+            file=sys.stderr,
+            flush=True,
+        )
+
+    def debug(self, msg: str, **fields) -> None:
+        self._emit(10, "DEBUG", msg, fields)
+
+    def info(self, msg: str, **fields) -> None:
+        self._emit(20, "INFO", msg, fields)
+
+    def warn(self, msg: str, **fields) -> None:
+        self._emit(30, "WARN", msg, fields)
+
+    warning = warn
+
+    def error(self, msg: str, **fields) -> None:
+        self._emit(40, "ERROR", msg, fields)
+
+
+def get_logger(subsystem: str) -> Logger:
+    with _lock:
+        lg = _loggers.get(subsystem)
+        if lg is None:
+            lg = Logger(subsystem, _overrides.get(subsystem, _default_level))
+            _loggers[subsystem] = lg
+        return lg
+
+
+configure()

@@ -1,0 +1,192 @@
+"""One metrics registry, Prometheus exposition done right.
+
+The `mz-ore metrics` analogue: every subsystem registers Counter / Gauge /
+Histogram families against the process-global :data:`REGISTRY` and bumps them
+at the call site; ``/metrics`` renders the registry instead of hand-rolling
+text. The renderer emits ``# HELP`` / ``# TYPE`` for every family (including
+empty ones, so tooling can assert a family exists before traffic) and escapes
+label values per the exposition format (backslash, double-quote, newline).
+
+Scrape-time values that live on engine objects (catalog counts, overload
+counters, …) are passed to :func:`render` as extra :class:`Snapshot` families
+— gather the numbers under whatever lock guards them, render *outside* it.
+
+Histograms use power-of-two buckets (the engine's house style for duration
+histograms): an observation lands in the smallest power of two >= value, and
+rendering emits cumulative ``_bucket{le=...}`` counts plus ``_sum``/``_count``.
+
+Cross-process: :meth:`Registry.snapshot` returns a plain-tuple form of every
+family that pickles over CTP, so clusterd-side counters (exchange bytes,
+persist ops) surface in the coordinator's exposition with a ``process`` label.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+def escape_label(v: object) -> str:
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def escape_help(v: str) -> str:
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _labels_text(labels) -> str:
+    """``{k="v",...}`` for a (key, value) item tuple; '' when unlabeled."""
+    if not labels:
+        return ""
+    return "{" + ",".join(f'{k}="{escape_label(v)}"' for k, v in labels) + "}"
+
+
+def _pow2_bucket(v: float) -> int:
+    b = 1
+    while b < v:
+        b <<= 1
+    return b
+
+
+@dataclass
+class Snapshot:
+    """A renderable family snapshot: scrape-time values not held in the
+    registry. ``samples`` is [(labels_items_tuple, value)]; for kind
+    'histogram', value is a ({bucket_le: count}, sum, count) triple."""
+
+    name: str
+    kind: str  # counter | gauge | histogram
+    help: str
+    samples: list = field(default_factory=list)
+
+
+class Family:
+    def __init__(self, name: str, kind: str, help: str, labelnames: tuple):
+        self.name = name
+        self.kind = kind
+        self.help = help
+        self.labelnames = labelnames
+        self._lock = threading.Lock()
+        # labels value-tuple -> float, or for histograms -> [buckets, sum, count]
+        self._values: dict = {}
+
+    def _key(self, labels: dict) -> tuple:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(labels)} != declared {sorted(self.labelnames)}"
+            )
+        return tuple(labels[k] for k in self.labelnames)
+
+    def inc(self, n: float = 1, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0) + n
+
+    def set(self, v: float, **labels) -> None:
+        k = self._key(labels)
+        with self._lock:
+            self._values[k] = v
+
+    def observe(self, v: float, **labels) -> None:
+        k = self._key(labels)
+        b = _pow2_bucket(v)
+        with self._lock:
+            st = self._values.get(k)
+            if st is None:
+                st = self._values[k] = [{}, 0.0, 0]
+            st[0][b] = st[0].get(b, 0) + 1
+            st[1] += v
+            st[2] += 1
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0)
+
+    def _snapshot_samples(self) -> list:
+        with self._lock:
+            out = []
+            for k, v in self._values.items():
+                labels = tuple(zip(self.labelnames, k))
+                if self.kind == "histogram":
+                    out.append((labels, (dict(v[0]), v[1], v[2])))
+                else:
+                    out.append((labels, v))
+            return out
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: dict[str, Family] = {}
+
+    def _family(self, name: str, kind: str, help: str, labels: tuple) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = Family(name, kind, help, tuple(labels))
+            elif fam.kind != kind:
+                raise ValueError(f"{name} re-registered as {kind}, was {fam.kind}")
+            return fam
+
+    def counter(self, name: str, help: str, labels: tuple = ()) -> Family:
+        return self._family(name, "counter", help, labels)
+
+    def gauge(self, name: str, help: str, labels: tuple = ()) -> Family:
+        return self._family(name, "gauge", help, labels)
+
+    def histogram(self, name: str, help: str, labels: tuple = ()) -> Family:
+        return self._family(name, "histogram", help, labels)
+
+    def snapshot(self) -> tuple:
+        """Picklable ((name, kind, help, samples), ...) for CTP shipping."""
+        with self._lock:
+            fams = list(self._families.values())
+        return tuple((f.name, f.kind, f.help, tuple(f._snapshot_samples())) for f in fams)
+
+    def families(self) -> list[Snapshot]:
+        with self._lock:
+            fams = list(self._families.values())
+        return [Snapshot(f.name, f.kind, f.help, f._snapshot_samples()) for f in fams]
+
+    def expose(self, extra=()) -> str:
+        """Full exposition text: registered families plus scrape-time extras.
+
+        Callers gather `extra` values under their own locks; this function
+        only formats — never call it while holding an engine lock.
+        """
+        return render(self.families() + list(extra))
+
+
+def render(families) -> str:
+    lines: list[str] = []
+    seen: set[str] = set()
+    for fam in families:
+        name, kind, help_, samples = fam.name, fam.kind, fam.help, fam.samples
+        if name not in seen:
+            seen.add(name)
+            lines.append(f"# HELP {name} {escape_help(help_)}")
+            lines.append(f"# TYPE {name} {kind}")
+        for labels, v in samples:
+            lt = _labels_text(labels)
+            if kind == "histogram":
+                buckets, total, count = v
+                acc = 0
+                for le in sorted(buckets):
+                    acc += buckets[le]
+                    blabels = labels + (("le", le),)
+                    lines.append(f"{name}_bucket{_labels_text(blabels)} {acc}")
+                inf = labels + (("le", "+Inf"),)
+                lines.append(f"{name}_bucket{_labels_text(inf)} {count}")
+                lines.append(f"{name}_sum{lt} {total}")
+                lines.append(f"{name}_count{lt} {count}")
+            else:
+                lines.append(f"{name}{lt} {v}")
+    return "\n".join(lines) + "\n"
+
+
+REGISTRY = Registry()

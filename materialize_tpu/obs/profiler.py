@@ -1,0 +1,85 @@
+"""Dyncfg-gated jax.profiler hooks for the fused path.
+
+`enable_jax_profiler` / `jax_profiler_dir` (adapter/dyncfg.py) gate trace
+collection: when enabled, the coordinator (and clusterd, via the config
+snapshot on CreateInstance) starts a `jax.profiler` trace into the dump dir,
+and the fused renderer wraps each compiled tick in a TraceAnnotation named
+after the dataflow so device time in the resulting trace attributes to plan
+nodes (the r2-style TPU trace workflow — see doc/OBSERVABILITY.md).
+
+Zero-overhead-when-off guarantee: every hook first checks a module-level
+bool; disabled calls cost one attribute load and never import or touch jax.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+_lock = threading.Lock()
+_enabled = False
+_tracing = False
+_dir = ""
+
+
+def configure(enabled: bool, dump_dir: str = "") -> None:
+    """Apply the dyncfg pair; starts/stops a jax.profiler trace when a dump
+    dir is set. Failures (unsupported backend, bad dir) log and disable
+    rather than raise — profiling must never take the engine down."""
+    global _enabled, _tracing, _dir
+    with _lock:
+        _dir = dump_dir or ""
+        if enabled and not _enabled:
+            _enabled = True
+            if _dir:
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(_dir)
+                    _tracing = True
+                except Exception as e:  # pragma: no cover - backend-specific
+                    from . import log
+
+                    log.get_logger("profiler").warn(f"start_trace failed: {e}")
+        elif not enabled and _enabled:
+            _enabled = False
+            if _tracing:
+                _tracing = False
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception as e:  # pragma: no cover - backend-specific
+                    from . import log
+
+                    log.get_logger("profiler").warn(f"stop_trace failed: {e}")
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+@contextmanager
+def annotate(name: str):
+    """TraceAnnotation around a host-side region (one fused tick); shows up
+    as a named slice on the TPU trace timeline."""
+    if not _enabled:
+        yield
+        return
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextmanager
+def named_scope(name: str):
+    """jax.named_scope for trace/compile-time op attribution (HLO op names
+    carry the scope, so per-operator HBM/FLOP time is attributable)."""
+    if not _enabled:
+        yield
+        return
+    import jax
+
+    with jax.named_scope(name):
+        yield

@@ -1,0 +1,195 @@
+"""Cross-process tracing: spans with trace contexts that ride CTP frames.
+
+The analogue of the reference's tracing stack (mz-tracing +
+orchestrator-tracing, doc/developer/tracing.md), upgraded from the original
+single-process ring buffer: a *trace* is minted per statement at the frontend
+(`Tracer.trace`), its (trace_id, parent span_id) context travels on CTP
+command envelopes (cluster/protocol.py `Traced`), remote processes adopt the
+context (`Tracer.adopt_scope`), record their own child spans, and ship
+completed spans back on the response (`TracedResponse`) where the caller
+`absorb`s them into its ring. `mz_trace_spans` then shows one statement's
+end-to-end timeline — admission wait, coordinator planning, per-shard
+exchange/step, merge — and EXPLAIN TIMELINE renders the tree.
+
+Span ids are pid-prefixed so they stay unique across processes without
+coordination; `process` names the recording process (``coord``, ``shard0``,
+…). ``log_filter`` still gates stderr emission exactly as before.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import sys
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+from dataclasses import dataclass
+
+
+@dataclass
+class Span:
+    id: int
+    parent: int
+    name: str
+    start_ns: int
+    duration_ns: int = -1  # -1 while open
+    trace_id: int = 0  # 0 = not part of a statement trace
+    process: str = "coord"
+
+
+def _pid_prefix() -> int:
+    # 22 bits of pid above 40 bits of counter: ids collide across processes
+    # only after 2^40 spans in one process, and stay positive int64
+    return (os.getpid() & 0x3FFFFF) << 40
+
+
+class Tracer:
+    def __init__(self, capacity: int = 2048):
+        self.spans: deque[Span] = deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+        self.stderr_level: str = "off"  # off | info | debug
+        self.process: str = "coord"
+        # context adopted from a remote parent: (trace_id, parent_span_id).
+        # Process-global on purpose — clusterd worker threads have no
+        # thread-local parent and fall back to it, which parents their spans
+        # under the command span that fanned the work out.
+        self._adopted: tuple | None = None
+        # completed spans awaiting shipment on the next command response
+        # (only populated when shipping is on, i.e. in remote processes)
+        self._pending: deque[Span] = deque(maxlen=4096)
+        self._ship = False
+
+    # -- configuration -------------------------------------------------------
+
+    def set_filter(self, level: str) -> None:
+        self.stderr_level = level
+
+    def set_process(self, name: str) -> None:
+        self.process = name
+
+    def set_shipping(self, on: bool) -> None:
+        self._ship = on
+
+    # -- context -------------------------------------------------------------
+
+    def _next_id(self) -> int:
+        return _pid_prefix() | (next(self._ids) & ((1 << 40) - 1))
+
+    def current_context(self) -> tuple | None:
+        """(trace_id, span_id) to propagate to a remote process, or None.
+
+        Must be captured on the *calling* thread — thread-locals do not cross
+        the per-shard request threads in the sharded controller.
+        """
+        cur = getattr(self._local, "current", None)
+        return cur if cur is not None else self._adopted
+
+    @contextmanager
+    def adopt_scope(self, ctx: tuple | None):
+        """Install a remote (trace_id, span_id) as the process-global parent
+        fallback for the duration of a command dispatch."""
+        prev = self._adopted
+        self._adopted = tuple(ctx) if ctx is not None else None
+        try:
+            yield
+        finally:
+            self._adopted = prev
+
+    # -- recording -----------------------------------------------------------
+
+    @contextmanager
+    def span(self, name: str, trace_id: int | None = None):
+        prev = getattr(self._local, "current", None)
+        ctx = prev if prev is not None else self._adopted
+        tid = trace_id if trace_id is not None else (ctx[0] if ctx else 0)
+        parent = ctx[1] if ctx else 0
+        s = Span(self._next_id(), parent, name, time.time_ns(), -1, tid, self.process)
+        self._local.current = (tid, s.id)
+        try:
+            yield s
+        finally:
+            s.duration_ns = time.time_ns() - s.start_ns
+            self._local.current = prev
+            self.spans.append(s)
+            if self._ship and tid:
+                self._pending.append(s)
+            if self.stderr_level in ("info", "debug"):
+                print(
+                    f"[trace] {name} {s.duration_ns/1e6:.2f}ms (span {s.id}<-{s.parent})",
+                    file=sys.stderr,
+                )
+
+    @contextmanager
+    def trace(self, name: str):
+        """Mint a fresh trace rooted at a new span (per-statement entry
+        point); the root ignores any enclosing context."""
+        tid = self._next_id()
+        prev = getattr(self._local, "current", None)
+        s = Span(self._next_id(), 0, name, time.time_ns(), -1, tid, self.process)
+        self._local.current = (tid, s.id)
+        try:
+            yield s
+        finally:
+            s.duration_ns = time.time_ns() - s.start_ns
+            self._local.current = prev
+            self.spans.append(s)
+            if self._ship:
+                self._pending.append(s)
+
+    # -- shipping ------------------------------------------------------------
+
+    def drain_pending(self) -> tuple:
+        out = []
+        while True:
+            try:
+                out.append(self._pending.popleft())
+            except IndexError:
+                return tuple(out)
+
+    def absorb(self, spans) -> None:
+        """Append spans shipped from a remote process into the local ring."""
+        for s in spans:
+            self.spans.append(s)
+
+    # -- queries -------------------------------------------------------------
+
+    def recent(self, n: int = 256) -> list[Span]:
+        return list(self.spans)[-n:]
+
+    def spans_for_trace(self, trace_id: int) -> list[Span]:
+        return [s for s in self.spans if s.trace_id == trace_id]
+
+
+TRACER = Tracer()
+span = TRACER.span
+
+
+def render_timeline(spans: list[Span]) -> list[str]:
+    """Indented tree of one trace's spans, in start order, durations in ms.
+
+    Spans whose parent is missing from the set (e.g. evicted from a ring)
+    render as roots rather than vanishing.
+    """
+    spans = sorted(spans, key=lambda s: (s.start_ns, s.id))
+    ids = {s.id for s in spans}
+    children: dict[int, list[Span]] = {}
+    roots: list[Span] = []
+    for s in spans:
+        if s.parent in ids:
+            children.setdefault(s.parent, []).append(s)
+        else:
+            roots.append(s)
+    lines: list[str] = []
+
+    def walk(s: Span, depth: int) -> None:
+        dur = f"{s.duration_ns/1e6:.3f}ms" if s.duration_ns >= 0 else "open"
+        lines.append(f"{'  ' * depth}{s.name} [{s.process}] {dur}")
+        for c in children.get(s.id, []):
+            walk(c, depth + 1)
+
+    for r in roots:
+        walk(r, 0)
+    return lines

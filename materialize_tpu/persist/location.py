@@ -15,8 +15,50 @@ import json
 import os
 import tempfile
 import threading
+import time
 from dataclasses import dataclass
 from typing import Optional
+
+from ..obs import metrics as obs_metrics
+
+# Durable-substrate op counters/latencies (the persist metrics families the
+# reference exports per external op, src/persist/src/metrics.rs). Registered
+# at import so /metrics and the metrics lint see the families even before
+# the first durable op runs. Memory impls stay uninstrumented: they model
+# RAM, and tests assert on the durable path's numbers.
+_OPS = obs_metrics.REGISTRY.counter(
+    "mzt_persist_ops_total",
+    "durable blob/consensus operations by kind",
+    labels=("op",),
+)
+_OP_NS = obs_metrics.REGISTRY.histogram(
+    "mzt_persist_op_duration_ns",
+    "latency of durable blob/consensus operations",
+    labels=("op",),
+)
+_BLOB_BYTES = obs_metrics.REGISTRY.counter(
+    "mzt_persist_blob_bytes_total",
+    "payload bytes moved through the durable blob store",
+    labels=("dir",),
+)
+
+
+class _timed:
+    """Times one durable op into the counters above (success or raise —
+    a failed fsync's latency is exactly the interesting kind)."""
+
+    __slots__ = ("op", "t0")
+
+    def __init__(self, op: str) -> None:
+        self.op = op
+
+    def __enter__(self) -> None:
+        self.t0 = time.perf_counter_ns()
+
+    def __exit__(self, *exc) -> bool:
+        _OPS.inc(op=self.op)
+        _OP_NS.observe(time.perf_counter_ns() - self.t0, op=self.op)
+        return False
 
 
 # -- the shared local-FS layout mechanics (FileBlob + FileConsensus) ----------
@@ -125,18 +167,24 @@ class FileBlob(Blob):
         return os.path.join(self.root, key.replace("/", "__"))
 
     def get(self, key):
-        try:
-            with open(self._path(key), "rb") as f:
-                return f.read()
-        except FileNotFoundError:
-            pass
-        try:
-            with open(self._legacy_path(key), "rb") as f:
-                return f.read()
-        except (FileNotFoundError, IsADirectoryError):
-            # ONLY not-found maps to None: a real I/O failure (EIO, EACCES)
-            # must surface loudly, not masquerade as a missing blob
-            return None
+        with _timed("blob_get"):
+            try:
+                with open(self._path(key), "rb") as f:
+                    data = f.read()
+                _BLOB_BYTES.inc(len(data), dir="read")
+                return data
+            except FileNotFoundError:
+                pass
+            try:
+                with open(self._legacy_path(key), "rb") as f:
+                    data = f.read()
+                _BLOB_BYTES.inc(len(data), dir="read")
+                return data
+            except (FileNotFoundError, IsADirectoryError):
+                # ONLY not-found maps to None: a real I/O failure (EIO,
+                # EACCES) must surface loudly, not masquerade as a missing
+                # blob
+                return None
 
     def set(self, key, value):
         # Durability order matters: payload fsync BEFORE the rename, then the
@@ -144,30 +192,37 @@ class FileBlob(Blob):
         # references this blob; without these two fsyncs an acked batch could
         # vanish on power loss while the consensus pointer to it survives —
         # breaking the definite-collection guarantee.
-        fd, tmp = tempfile.mkstemp(dir=self.root)
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(value)
-                f.flush()
-                os.fsync(f.fileno())
-            os.replace(tmp, self._path(key))
-            _fsync_dir(self.root)
-        except BaseException:
+        with _timed("blob_set"):
+            fd, tmp = tempfile.mkstemp(dir=self.root)
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+                with os.fdopen(fd, "wb") as f:
+                    f.write(value)
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp, self._path(key))
+                _fsync_dir(self.root)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+            _BLOB_BYTES.inc(len(value), dir="write")
 
     def delete(self, key):
-        for path in (self._path(key), self._legacy_path(key)):
-            try:
-                os.unlink(path)
-            except (FileNotFoundError, IsADirectoryError):
-                pass  # other OSErrors surface: GC must not count a
-                # still-existing blob as deleted
+        with _timed("blob_delete"):
+            for path in (self._path(key), self._legacy_path(key)):
+                try:
+                    os.unlink(path)
+                except (FileNotFoundError, IsADirectoryError):
+                    pass  # other OSErrors surface: GC must not count a
+                    # still-existing blob as deleted
 
     def list_keys(self, prefix=""):
+        with _timed("blob_list"):
+            return self._list_keys(prefix)
+
+    def _list_keys(self, prefix=""):
         out = []
         for name in os.listdir(self.root):
             key = _decode_key(name)
@@ -279,7 +334,8 @@ class FileConsensus(Consensus):
         return None
 
     def head(self, key):
-        return self._read(key)
+        with _timed("consensus_head"):
+            return self._read(key)
 
     def list_keys(self, prefix=""):
         out = set()
@@ -297,7 +353,7 @@ class FileConsensus(Consensus):
         return sorted(out)
 
     def compare_and_set(self, key, expected_seqno, data):
-        with self._lock:
+        with self._lock, _timed("consensus_cas"):
             cur = self._read(key)
             cur_seq = cur.seqno if cur is not None else None
             if cur_seq != expected_seqno:

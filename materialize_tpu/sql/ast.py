@@ -316,7 +316,7 @@ class SelectStatement:
 
 @dataclass(frozen=True)
 class Explain:
-    stage: str  # plan | optimized | physical
+    stage: str  # raw | decorrelated | optimized | physical | timestamp | timeline
     statement: Any
 
 

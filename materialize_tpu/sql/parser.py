@@ -348,6 +348,11 @@ class Parser:
             self.next()
             self.eat_kw("for")
             return ast.Explain("timestamp", self.parse_statement())
+        if self.peek().kind == "IDENT" and self.peek().value == "timeline":
+            # EXPLAIN TIMELINE <stmt>: run it and render the span tree
+            self.next()
+            self.eat_kw("for")
+            return ast.Explain("timeline", self.parse_statement())
         if self.peek().kind == "IDENT" and self.peek().value in ("raw", "decorrelated", "optimized", "physical"):
             stage = self.next().value
             if self.peek().kind == "IDENT" and self.peek().value == "plan":

@@ -11,9 +11,11 @@ process.
 from __future__ import annotations
 
 import os
-import sys
+
+from ..obs import log as obs_log
 
 _PAGE = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+_log = obs_log.get_logger("memory")
 
 
 def rss_mb() -> float:
@@ -42,8 +44,9 @@ class MemoryLimiter:
             )
         if rss > self.limit_mb * self.soft_frac and not self._warned:
             self._warned = True
-            print(
-                f"[memory-limiter] RSS {rss:.0f} MiB above "
-                f"{self.soft_frac:.0%} of the {self.limit_mb} MiB limit",
-                file=sys.stderr,
+            _log.warn(
+                "RSS above soft limit",
+                rss_mb=round(rss),
+                soft_frac=self.soft_frac,
+                limit_mb=self.limit_mb,
             )

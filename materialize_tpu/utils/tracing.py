@@ -1,62 +1,6 @@
-"""Tracing: structured spans with a dynamic filter, queryable in SQL.
-
-The analogue of the reference's tracing stack (mz-tracing +
-orchestrator-tracing, doc/developer/tracing.md): spans record wall-clock
-durations into a ring buffer; `log_filter` (an ALTER SYSTEM-settable dyncfg in
-the reference) gates stderr emission; recent spans surface through the
-`mz_trace_spans` introspection relation instead of an OpenTelemetry exporter.
+"""Back-compat shim: the tracer moved to obs/spans.py (the observability
+package), growing cross-process trace contexts on the way. Importers of
+``utils.tracing`` keep working; new code should import from ``..obs.spans``.
 """
 
-from __future__ import annotations
-
-import itertools
-import sys
-import threading
-import time
-from collections import deque
-from contextlib import contextmanager
-from dataclasses import dataclass
-
-
-@dataclass
-class Span:
-    id: int
-    parent: int
-    name: str
-    start_ns: int
-    duration_ns: int = -1  # -1 while open
-
-
-class Tracer:
-    def __init__(self, capacity: int = 2048):
-        self.spans: deque[Span] = deque(maxlen=capacity)
-        self._ids = itertools.count(1)
-        self._local = threading.local()
-        self.stderr_level: str = "off"  # off | info | debug
-
-    def set_filter(self, level: str) -> None:
-        self.stderr_level = level
-
-    @contextmanager
-    def span(self, name: str):
-        parent = getattr(self._local, "current", 0)
-        s = Span(next(self._ids), parent, name, time.time_ns())
-        self._local.current = s.id
-        try:
-            yield s
-        finally:
-            s.duration_ns = time.time_ns() - s.start_ns
-            self._local.current = parent
-            self.spans.append(s)
-            if self.stderr_level in ("info", "debug"):
-                print(
-                    f"[trace] {name} {s.duration_ns/1e6:.2f}ms (span {s.id}<-{s.parent})",
-                    file=sys.stderr,
-                )
-
-    def recent(self, n: int = 256) -> list[Span]:
-        return list(self.spans)[-n:]
-
-
-TRACER = Tracer()
-span = TRACER.span
+from ..obs.spans import TRACER, Span, Tracer, span  # noqa: F401

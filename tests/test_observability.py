@@ -1,0 +1,396 @@
+"""End-to-end observability: structured logs, the metrics registry,
+cross-process trace spans, merged introspection relations, and the
+zero-overhead-when-off contract (obs/, ISSUE: operator-level logging).
+
+The sharded test boots a REAL 2-process × 2-worker compute replica and
+asserts the merged introspection relations return live, internally
+consistent rows through plain SQL — the partitioned-peek merge applied to
+logging.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from materialize_tpu.adapter import Coordinator
+from materialize_tpu.obs import log as obs_log
+from materialize_tpu.obs import metrics as obs_metrics
+from materialize_tpu.obs.spans import Tracer, render_timeline
+
+# -- structured logging -------------------------------------------------------
+
+
+def test_log_spec_parsing():
+    default, over = obs_log.parse_spec("mesh=debug,persist=info")
+    assert default == obs_log._LEVELS["warn"]
+    assert over == {"mesh": 10, "persist": 20}
+    default, over = obs_log.parse_spec("info,mesh=debug")
+    assert default == 20 and over["mesh"] == 10
+    # unknown level names fall back to the default instead of raising
+    default, over = obs_log.parse_spec("bogus=nope")
+    assert over["bogus"] == default
+
+
+def test_log_emission_levels_and_context(capsys):
+    obs_log.configure("obs_test=info")
+    try:
+        lg = obs_log.get_logger("obs_test")
+        lg.debug("hidden at info")
+        lg.info("shown", k=1)
+        obs_log.set_context(shard=3)
+        try:
+            lg.warn("ctx line")
+        finally:
+            obs_log.set_context(shard=None)
+    finally:
+        obs_log.configure("")
+    err = capsys.readouterr().err
+    assert "hidden at info" not in err
+    assert "INFO" in err and "obs_test" in err and "shown k=1" in err
+    assert "obs_test[shard=3] ctx line" in err
+
+
+def test_log_default_level_spares_overrides(capsys):
+    obs_log.configure("quiet_sub=off")
+    try:
+        obs_log.set_default_level("info")
+        quiet = obs_log.get_logger("quiet_sub")
+        other = obs_log.get_logger("other_sub")
+        quiet.error("must stay silent")
+        other.info("now visible")
+    finally:
+        obs_log.configure("")
+    err = capsys.readouterr().err
+    assert "must stay silent" not in err
+    assert "now visible" in err
+
+
+# -- metrics registry ---------------------------------------------------------
+
+
+def test_metrics_exposition_escaping_and_headers():
+    reg = obs_metrics.Registry()
+    c = reg.counter("t_total", "help with\nnewline", labels=("q",))
+    c.inc(2, q='we"ird\\label')
+    reg.gauge("t_gauge", "a gauge").set(1.5)
+    reg.histogram("t_empty_hist", "no samples yet")
+    text = reg.expose()
+    # HELP/TYPE exactly once per family, even for families with no samples
+    assert text.count("# TYPE t_total counter") == 1
+    assert "# HELP t_total help with\\nnewline" in text
+    assert "# TYPE t_empty_hist histogram" in text
+    # label escaping: backslash and double-quote
+    assert 't_total{q="we\\"ird\\\\label"} 2' in text
+
+
+def test_metrics_histogram_buckets_cumulative():
+    reg = obs_metrics.Registry()
+    h = reg.histogram("t_h_ns", "hist")
+    h.observe(3)
+    h.observe(5)
+    text = reg.expose()
+    assert 't_h_ns_bucket{le="4"} 1' in text
+    assert 't_h_ns_bucket{le="8"} 2' in text
+    assert 't_h_ns_bucket{le="+Inf"} 2' in text
+    assert "t_h_ns_count 2" in text
+    assert "t_h_ns_sum 8" in text
+
+
+def test_metrics_snapshot_ships_and_rerenders_with_process_label():
+    import pickle
+
+    reg = obs_metrics.Registry()
+    reg.counter("s_total", "h", labels=("op",)).inc(op="get")
+    snap = pickle.loads(pickle.dumps(reg.snapshot()))  # the CTP trip
+    fams = [
+        obs_metrics.Snapshot(
+            n, k, hp, [(tuple(l) + (("process", "shard0"),), v) for l, v in samples]
+        )
+        for n, k, hp, samples in snap
+    ]
+    text = obs_metrics.render(fams)
+    assert 's_total{op="get",process="shard0"} 1' in text
+
+
+def test_http_metrics_text_has_registry_and_engine_families():
+    from materialize_tpu.frontend.http_server import metrics_text
+
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int)")
+    c.execute("INSERT INTO t VALUES (1)")
+    c.execute("SELECT a FROM t")
+    text = metrics_text(c, threading.Lock())
+    for fam in (
+        "mzt_catalog_items",
+        "mzt_oracle_read_ts",
+        "mzt_peek_duration_bucket",
+        "mzt_persist_ops_total",
+        "mzt_dataflow_tick_duration_ns",
+    ):
+        assert f"# TYPE {fam} " in text, fam
+    assert text.count("# TYPE mzt_catalog_items gauge") == 1
+
+
+# -- spans --------------------------------------------------------------------
+
+
+def test_span_parentage_and_timeline():
+    tr = Tracer()
+    with tr.trace("root") as root:
+        with tr.span("child") as ch:
+            with tr.span("grandchild") as gc:
+                pass
+        with tr.span("sibling") as sib:
+            pass
+    assert ch.parent == root.id and gc.parent == ch.id and sib.parent == root.id
+    assert {s.trace_id for s in (root, ch, gc, sib)} == {root.trace_id}
+    lines = render_timeline(tr.spans_for_trace(root.trace_id))
+    assert lines[0].startswith("root")
+    assert lines[1].startswith("  child")
+    assert lines[2].startswith("    grandchild")
+    assert lines[3].startswith("  sibling")
+
+
+def test_adopted_context_parents_worker_threads():
+    # the clusterd dispatch shape: adopt the wire context, open the command
+    # span, re-adopt (tid, command_span) so worker THREADS (no thread-local
+    # parent) attach under the command, then ship completed spans
+    tr = Tracer()
+    tr.set_shipping(True)
+    got = []
+    with tr.adopt_scope((42, 7)):
+        with tr.span("cmd") as cmd:
+            with tr.adopt_scope((42, cmd.id)):
+
+                def work():
+                    with tr.span("worker") as w:
+                        got.append(w)
+
+                t = threading.Thread(target=work)
+                t.start()
+                t.join()
+    assert cmd.trace_id == 42 and cmd.parent == 7
+    assert got[0].trace_id == 42 and got[0].parent == cmd.id
+    shipped = {s.name for s in tr.drain_pending()}
+    assert {"cmd", "worker"} <= shipped
+    assert tr.drain_pending() == ()  # drained
+
+
+def test_timeline_orphan_parent_renders_as_root():
+    tr = Tracer()
+    with tr.adopt_scope((9, 12345)):  # parent span not in the ring
+        with tr.span("arrived"):
+            pass
+    lines = render_timeline(tr.spans_for_trace(9))
+    assert lines and lines[0].startswith("arrived")
+
+
+def test_mz_trace_spans_and_explain_timeline_sql():
+    c = Coordinator()
+    c.execute("CREATE TABLE t (a int)")
+    c.execute("INSERT INTO t VALUES (1), (2)")
+    c.execute("SELECT a FROM t")
+    rows = c.execute(
+        "SELECT name, duration_ns, trace_id, process FROM mz_trace_spans"
+    ).rows
+    assert any(n.startswith("execute:") and d >= 0 for n, d, _t, _p in rows)
+    assert all(p for _n, _d, _t, p in rows)  # every span names its process
+    # statement spans carry a minted trace id
+    assert any(t != 0 for _n, _d, t, _p in rows)
+
+    r = c.execute("EXPLAIN TIMELINE FOR SELECT a FROM t")
+    text = [row[0] for row in r.rows]
+    assert text[0].startswith("timeline:SelectStatement")
+    assert any(line.startswith("  execute:") for line in text)
+    assert any("plan" in line or "peek" in line for line in text)
+
+
+# -- zero-overhead contract ---------------------------------------------------
+
+
+def _run_join_workload(enable_logging: bool):
+    c = Coordinator()
+    if enable_logging:
+        c.execute("ALTER SYSTEM SET enable_operator_logging = true")
+    c.execute("CREATE TABLE l (k int, a int)")
+    c.execute("CREATE TABLE r (k int, b int)")
+    c.execute(
+        "CREATE MATERIALIZED VIEW j AS"
+        " SELECT l.k, a, b FROM l, r WHERE l.k = r.k"
+    )
+    c.execute("INSERT INTO l VALUES (1, 10), (2, 20), (3, 30)")
+    c.execute("INSERT INTO r VALUES (1, 100), (2, 200), (2, 201)")
+    rows = sorted(c.execute("SELECT * FROM j").rows)
+    return c, rows
+
+
+def test_operator_logging_toggle_output_identical():
+    c_off, rows_off = _run_join_workload(False)
+    c_on, rows_on = _run_join_workload(True)
+    assert rows_on == rows_off and rows_off  # identical, non-trivial results
+    # rows in/out accrue only while logging is on (the per-row work is gated)
+    rates_off = c_off.execute(
+        "SELECT rows_in, rows_out FROM mz_dataflow_operator_rates"
+    ).rows
+    rates_on = c_on.execute(
+        "SELECT rows_in, rows_out FROM mz_dataflow_operator_rates"
+    ).rows
+    assert all(ri == 0 and ro == 0 for ri, ro in rates_off)
+    assert any(ri > 0 or ro > 0 for ri, ro in rates_on)
+    # elapsed/invocations stay on regardless (two clock reads per dispatch)
+    ops = c_off.execute("SELECT invocations FROM mz_scheduling_elapsed").rows
+    assert any(inv >= 1 for (inv,) in ops)
+
+
+def test_arrangement_bytes_match_dedup_accounting():
+    # the SQL-visible bytes column must agree with the id-deduped
+    # owner-charges accounting the shared-MV benchmark reports (join-only
+    # workload: the bench walker does not traverse fused reduce state)
+    from benchmarks.bench_shared_mvs import arrangement_bytes
+
+    c, _rows = _run_join_workload(False)
+    sql_total = sum(
+        b
+        for (b, rep) in c.execute(
+            "SELECT bytes, replica FROM mz_arrangement_sizes"
+        ).rows
+        if rep == ""
+    )
+    assert sql_total == arrangement_bytes(c) > 0
+
+
+# -- sharded replica: merged introspection + cross-process spans --------------
+
+
+def test_sharded_replica_introspection_and_spans(tmp_path):
+    from materialize_tpu.models import auction
+    from materialize_tpu.persist import ShardMachine
+    from materialize_tpu.utils.tracing import TRACER
+
+    wall_t0 = time.time_ns()
+    coord = Coordinator(data_dir=str(tmp_path / "d"))
+    # BEFORE the replica boots: the dyncfg snapshot ships on CreateInstance
+    coord.execute("ALTER SYSTEM SET enable_operator_logging = true")
+    ctl = coord.create_compute_replica("r1", "2x2")
+    try:
+        desc = auction.bids_sum_count()
+        ctl.create_dataflow("df1", desc, {"bids": "bids"}, as_of=0)
+        shard = ShardMachine(coord.blob, coord.consensus, "bids")
+        rows = [(1, 7, 10, 100, 0, 1), (2, 8, 10, 250, 0, 1), (3, 9, 11, 40, 0, 1)]
+        cols = {
+            f"c{i}": np.array([r[i] for r in rows], dtype=np.int64) for i in range(5)
+        }
+        cols["times"] = np.full(len(rows), 1, dtype=np.uint64)
+        cols["diffs"] = np.array([r[5] for r in rows], dtype=np.int64)
+        shard.compare_and_append(cols, 0, 2)
+        ctl.process_to(2)
+
+        # a traced replica peek: clusterd-side spans ship back on the
+        # response and land in the coordinator's ring with correct parentage
+        with TRACER.trace("test:replica_peek") as root:
+            got = coord.replica_peek("df1", "idx_bids_sum")
+        assert sorted(got) == [(10, 350, 2), (11, 40, 1)]
+        spans = TRACER.spans_for_trace(root.trace_id)
+        remote = [s for s in spans if s.process.startswith("shard")]
+        assert remote, "no clusterd-side spans shipped back"
+        cmd_spans = [s for s in remote if s.name.startswith("clusterd:")]
+        assert cmd_spans and all(s.parent == root.id for s in cmd_spans)
+        workers = [s for s in remote if s.name.startswith("worker")]
+        cmd_ids = {s.id for s in cmd_spans}
+        assert workers and all(s.parent in cmd_ids for s in workers)
+        assert {s.process for s in remote} == {"shard0", "shard1"}
+
+        # a coordinator-side file source feeds mz_source_statistics
+        p = tmp_path / "feed.jsonl"
+        p.write_text('{"id": 1, "v": 5}\n{"id": 2, "v": 6}\n')
+        coord.execute(
+            f"CREATE SOURCE feed (id int, v int) FROM FILE '{p}' (FORMAT JSON)"
+        )
+        coord.advance()
+
+        # merged relations through plain SQL ------------------------------
+        elapsed = coord.execute(
+            "SELECT dataflow, operator_type, elapsed_ns, invocations, replica"
+            " FROM mz_scheduling_elapsed"
+        ).rows
+        r1 = [r for r in elapsed if r[4] == "r1"]
+        assert r1 and all(df == "df1" for df, *_ in r1)
+        assert any("Reduce" in typ for _df, typ, _el, _inv, _rep in r1)
+        assert all(el >= 0 and inv >= 1 for _df, _typ, el, inv, _rep in r1)
+        # internal consistency: per-worker elapsed sums bounded by wall
+        # clock × worker count (4 workers step concurrently)
+        wall_ns = time.time_ns() - wall_t0
+        assert sum(r[2] for r in r1) <= wall_ns * 4
+
+        rates = coord.execute(
+            "SELECT rows_in, rows_out, replica FROM mz_dataflow_operator_rates"
+        ).rows
+        assert any(rep == "r1" and (ri > 0 or ro > 0) for ri, ro, rep in rates)
+
+        sizes = coord.execute(
+            "SELECT dataflow, arrangement, records, bytes, replica"
+            " FROM mz_arrangement_sizes"
+        ).rows
+        r1_sizes = [r for r in sizes if r[4] == "r1"]
+        assert r1_sizes and all(b > 0 for _d, _a, _rec, b, _r in r1_sizes[:1])
+        # the exported index holds exactly the output rows: each worker owns
+        # a key partition, and the cross-process merge sums them back to the
+        # full result cardinality
+        idx = [r for r in r1_sizes if r[1] == "index_trace"]
+        assert idx and sum(rec for _d, _a, rec, _b, _r in idx) == 2
+
+        hyd = coord.execute(
+            "SELECT dataflow, replica, hydrated, frontier FROM mz_hydration_statuses"
+        ).rows
+        r1_hyd = [r for r in hyd if r[1] == "r1" and r[0] == "df1"]
+        assert r1_hyd and all(h and fr >= 2 for _d, _r, h, fr in r1_hyd)
+
+        src = coord.execute(
+            "SELECT name, offset_committed, bytes_received, records_received"
+            " FROM mz_source_statistics"
+        ).rows
+        feed = [r for r in src if r[0] == "feed"]
+        assert feed and feed[0][1] > 0 and feed[0][2] > 0 and feed[0][3] == 2
+
+        # EXPLAIN TIMELINE over SQL sees the same engine
+        r = coord.execute("EXPLAIN TIMELINE FOR SELECT id FROM feed")
+        assert r.rows and r.rows[0][0].startswith("timeline:")
+    finally:
+        coord.drop_compute_replica("r1")
+
+
+# -- overhead guard (slow tier) ----------------------------------------------
+
+
+@pytest.mark.slow
+def test_q3_tick_overhead_within_5pct():
+    """Instrumented (enable_operator_logging=on) steady-state Q3-shaped tick
+    stays within 5% of the default (off) tick."""
+
+    def run(enable: bool) -> float:
+        c = Coordinator()
+        if enable:
+            c.execute("ALTER SYSTEM SET enable_operator_logging = true")
+        c.execute("CREATE SOURCE tp FROM LOAD GENERATOR TPCH (SCALE FACTOR 0.01)")
+        c.execute(
+            """CREATE MATERIALIZED VIEW q3 AS
+               SELECT l_orderkey, sum(l_extendedprice) AS revenue, count(*) AS n
+               FROM orders, lineitem
+               WHERE l_orderkey = o_orderkey AND o_orderdate < DATE '1995-03-15'
+               GROUP BY l_orderkey"""
+        )
+        for _ in range(3):  # warmup: compile + hydrate
+            c.advance()
+        samples = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            c.advance()
+            samples.append(time.perf_counter() - t0)
+        samples.sort()
+        return samples[len(samples) // 2]
+
+    base = run(False)
+    instrumented = run(True)
+    assert instrumented <= base * 1.05 + 0.010, (base, instrumented)
