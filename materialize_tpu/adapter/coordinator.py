@@ -233,6 +233,8 @@ class Coordinator:
     def _register_introspection(self) -> None:
         from .introspection import INTROSPECTION_TABLES, IntrospectionCollection
 
+        if not bool(self.configs.get("enable_introspection")):
+            return  # boot-time opt-out: no mz_* relations in the catalog
         for name, desc in INTROSPECTION_TABLES.items():
             item = CatalogItem(name, "introspection", desc=desc, global_id=f"si_{name}")
             self.catalog.items[name] = item

@@ -1,0 +1,43 @@
+"""mzlint: the unified static-analysis suite for materialize_tpu.
+
+One `ast` parse per file, a registered-pass catalogue (see
+passes/__init__.py), `# mzt: allow(<rule>)` inline suppressions with an
+unused-suppression check, and stable `rule_id:path:line` findings.
+
+    python -m materialize_tpu.analysis --all        # the CI gate
+    python -m materialize_tpu.analysis --rules lock-discipline,crash-swallow
+    python -m materialize_tpu.analysis --all --json # machine-readable
+
+Rule catalogue and how to add a pass: doc/STATIC_ANALYSIS.md.
+"""
+
+from .core import Finding, Project, Rule, SourceFile, run_rules
+from .passes import ALL_RULES, RULES_BY_ID
+
+__all__ = [
+    "ALL_RULES",
+    "RULES_BY_ID",
+    "Finding",
+    "Project",
+    "Rule",
+    "SourceFile",
+    "run_rules",
+    "load_project",
+]
+
+
+def load_project(root=None) -> Project:
+    """Parse every materialize_tpu/**/*.py under `root` (default: the repo
+    this package was imported from) into a Project."""
+    from pathlib import Path
+
+    if root is None:
+        root = Path(__file__).resolve().parents[2]
+    root = Path(root)
+    pkg = root / "materialize_tpu"
+    files = [
+        SourceFile.load(p, root)
+        for p in sorted(pkg.rglob("*.py"))
+        if "__pycache__" not in p.parts
+    ]
+    return Project(files, root)

@@ -1,0 +1,87 @@
+"""CLI driver: `python -m materialize_tpu.analysis [--rules ...] [--json]`.
+
+Exit status 0 only on zero findings AND zero unused suppressions — the
+single command tier-1 wires in via tests/test_analysis.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from . import ALL_RULES, RULES_BY_ID, load_project, run_rules
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m materialize_tpu.analysis",
+        description="mzlint: unified static analysis for materialize_tpu",
+    )
+    ap.add_argument(
+        "--rules",
+        help="comma-separated rule ids to run (default: every registered rule)",
+    )
+    ap.add_argument(
+        "--all",
+        action="store_true",
+        help="run every registered rule (the default; kept explicit for CI)",
+    )
+    ap.add_argument("--json", action="store_true", help="machine-readable output")
+    ap.add_argument("--list", action="store_true", help="list registered rules")
+    ap.add_argument("--root", default=None, help="repo root (default: autodetect)")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for rule in ALL_RULES:
+            tag = " [functional]" if rule.functional else ""
+            print(f"{rule.id:22s} {rule.description}{tag}")
+        return 0
+
+    if args.rules:
+        ids = [r.strip() for r in args.rules.split(",") if r.strip()]
+        unknown = [r for r in ids if r not in RULES_BY_ID]
+        if unknown:
+            print(
+                f"unknown rule id(s): {', '.join(unknown)} "
+                f"(--list shows the catalogue)",
+                file=sys.stderr,
+            )
+            return 2
+        rules = [RULES_BY_ID[r] for r in ids]
+    else:
+        rules = ALL_RULES
+
+    t0 = time.monotonic()
+    project = load_project(args.root)
+    findings = run_rules(project, rules, known_ids=set(RULES_BY_ID))
+    elapsed = time.monotonic() - t0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "rules": sorted(r.id for r in rules),
+                    "files": len(project.files),
+                    "findings": [f.as_json() for f in findings],
+                },
+                indent=2,
+                sort_keys=True,
+            )
+        )
+    else:
+        for f in findings:
+            print(f.render())
+        status = "FAIL" if findings else "OK"
+        print(
+            f"mzlint: {status} — {len(findings)} finding(s), "
+            f"{len(rules)} rule(s), {len(project.files)} files, "
+            f"{elapsed:.1f}s",
+            file=sys.stderr if findings else sys.stdout,
+        )
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

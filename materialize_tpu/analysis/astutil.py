@@ -1,0 +1,147 @@
+"""Shared AST helpers for mzlint passes: dotted names, lock discovery,
+with-guard shapes. Kept free of pass-specific policy."""
+
+from __future__ import annotations
+
+import ast
+
+#: threading constructors whose result is a mutual-exclusion guard
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: method calls that mutate the receiver in place (counted as writes of the
+#: attribute holding the receiver)
+MUTATORS = {
+    "append",
+    "appendleft",
+    "add",
+    "clear",
+    "discard",
+    "extend",
+    "insert",
+    "pop",
+    "popleft",
+    "popitem",
+    "remove",
+    "setdefault",
+    "update",
+}
+
+
+def dotted(node: ast.AST) -> str | None:
+    """`a.b.c` for Name/Attribute chains, else None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> str | None:
+    """The last identifier of a Name/Attribute chain (`self.a.b` -> 'b')."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def decorator_name(dec: ast.AST) -> str | None:
+    """Terminal name of a decorator, seeing through call parentheses:
+    `@dataclass(frozen=True)` -> 'dataclass'."""
+    if isinstance(dec, ast.Call):
+        dec = dec.func
+    return terminal_name(dec)
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """'x' for `self.x`, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def is_lockish_name(name: str | None) -> bool:
+    """Heuristic: an identifier that names a mutual-exclusion guard."""
+    return name is not None and (
+        "lock" in name or name == "cv" or name.endswith("_cv") or "cond" in name
+    )
+
+
+def class_lock_attrs(cls: ast.ClassDef) -> set[str]:
+    """Self-attributes assigned a threading.Lock/RLock/Condition anywhere in
+    the class body (`self._lock = threading.RLock()` and friends)."""
+    locks: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            name = terminal_name(fn)
+            if name in LOCK_FACTORIES:
+                for tgt in node.targets:
+                    attr = self_attr(tgt)
+                    if attr:
+                        locks.add(attr)
+    return locks
+
+
+def with_lock_names(stmt: ast.With) -> list[str]:
+    """Terminal identifiers of with-items that look like held locks
+    (`with self._lock, _timed("x"):` -> ['_lock'])."""
+    names = []
+    for item in stmt.items:
+        expr = item.context_expr
+        if isinstance(expr, ast.Call):
+            continue  # _timed(...), open(...): not a lock acquisition
+        name = terminal_name(expr)
+        if is_lockish_name(name):
+            names.append(name)
+    return names
+
+
+def write_targets(stmt: ast.stmt) -> list[ast.AST]:
+    """Target expressions mutated by an assignment-family statement."""
+    if isinstance(stmt, ast.Assign):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        return [stmt.target]
+    if isinstance(stmt, ast.Delete):
+        return list(stmt.targets)
+    return []
+
+
+def base_self_attr_of_target(tgt: ast.AST) -> str | None:
+    """The self-attribute a store ultimately mutates: `self.d[k] = v` and
+    `self.a.b = v` both write through 'd'/'a'."""
+    while isinstance(tgt, (ast.Subscript, ast.Starred)):
+        tgt = tgt.value
+    # peel chained attributes down to the one directly on self
+    while isinstance(tgt, ast.Attribute) and not (
+        isinstance(tgt.value, ast.Name) and tgt.value.id == "self"
+    ):
+        tgt = tgt.value
+    return self_attr(tgt)
+
+
+def handler_catches(handler: ast.ExceptHandler, names: set) -> bool:
+    """Does `except <type>` name one of `names`? (bare except matches if
+    None is in names)."""
+    t = handler.type
+    if t is None:
+        return None in names
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    return any(terminal_name(e) in names for e in exprs)
+
+
+def has_bare_reraise(handler: ast.ExceptHandler) -> bool:
+    """A `raise` with no exception anywhere in the handler body: the
+    allowlisted cleanup-then-reraise pattern."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise) and node.exc is None:
+            return True
+    return False

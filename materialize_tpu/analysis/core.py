@@ -1,0 +1,174 @@
+"""mzlint core: one parse per file, a rule registry, inline suppressions.
+
+The shared chassis for every static-analysis pass (the clippy-lint-registry
+analogue for this reproduction). Design contract:
+
+  * each file is read and `ast.parse`d exactly ONCE (`SourceFile`); every
+    rule sees the same tree, so adding a pass costs one visitor, not one
+    filesystem walk;
+  * rules are plain objects with an `id`, a path `scope`, and either a
+    per-file hook (`check_file`) or a whole-project hook (`check_project`
+    — for cross-file registry checks and the functional metrics rule);
+  * findings are `rule_id:path:line: message` and sort stably, so the CLI
+    and `--json` output are diffable across runs;
+  * `# mzt: allow(<rule-id>)` on (or immediately above) a line suppresses
+    matching findings on it; a suppression that suppresses nothing is
+    itself a finding (`unused-suppression`), so allows can't rot.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Iterable
+
+SUPPRESS_RE = re.compile(r"#\s*mzt:\s*allow\(\s*([a-z0-9_\-\s,]+?)\s*\)")
+
+#: rule id used for the framework-level unused/unknown-allow findings
+UNUSED_SUPPRESSION = "unused-suppression"
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.rule}:{self.path}:{self.line}: {self.message}"
+
+    def as_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Suppression:
+    __slots__ = ("comment_line", "target_line", "rules", "used")
+
+    def __init__(self, comment_line: int, target_line: int, rules: set):
+        self.comment_line = comment_line
+        self.target_line = target_line
+        self.rules = rules
+        self.used: set = set()
+
+
+class SourceFile:
+    """A module parsed exactly once: text, split lines, AST, suppressions."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self.suppressions: list[Suppression] = []
+        for i, line in enumerate(self.lines, 1):
+            m = SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            # a standalone comment covers the NEXT line; a trailing comment
+            # covers its own
+            target = i + 1 if line.strip().startswith("#") else i
+            self.suppressions.append(Suppression(i, target, rules))
+
+    @classmethod
+    def load(cls, path: Path, root: Path) -> "SourceFile":
+        return cls(path.relative_to(root).as_posix(), path.read_text())
+
+
+class Project:
+    """The file set under analysis plus (optionally) the repo root on disk.
+
+    Tests build synthetic projects from in-memory sources; the CLI builds
+    one from materialize_tpu/**/*.py. `root` is only needed by functional
+    rules that import the live package (metrics-coherence)."""
+
+    def __init__(self, files: list[SourceFile], root: Path | None = None):
+        self.files = files
+        self.root = root
+        self._by_rel = {f.rel: f for f in files}
+
+    def get(self, rel: str) -> SourceFile | None:
+        return self._by_rel.get(rel)
+
+    def find_suffix(self, suffix: str) -> SourceFile | None:
+        for f in self.files:
+            if f.rel.endswith(suffix):
+                return f
+        return None
+
+
+class Rule:
+    """One registered pass. Subclasses set `id`/`description` and override
+    `scope` plus `check_file` and/or `check_project`."""
+
+    id: str = ""
+    description: str = ""
+    #: functional rules boot live engine pieces instead of walking ASTs
+    functional: bool = False
+
+    def scope(self, rel: str) -> bool:
+        return True
+
+    def check_file(self, sf: SourceFile, project: Project) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, project: Project) -> Iterable[Finding]:
+        return ()
+
+
+def run_rules(
+    project: Project,
+    rules: list[Rule],
+    known_ids: set | None = None,
+) -> list[Finding]:
+    """Run `rules` over `project`; apply suppressions; report unused ones.
+
+    `known_ids` is the full registry (for flagging typo'd allow() ids even
+    when running a rule subset); defaults to the ids of `rules`."""
+    run_ids = {r.id for r in rules}
+    if known_ids is None:
+        known_ids = run_ids
+    raw: list[Finding] = []
+    for rule in rules:
+        for sf in project.files:
+            if rule.scope(sf.rel):
+                raw.extend(rule.check_file(sf, project))
+        raw.extend(rule.check_project(project))
+
+    kept: list[Finding] = []
+    for f in raw:
+        sf = project.get(f.path)
+        suppressed = False
+        if sf is not None:
+            for s in sf.suppressions:
+                if f.line == s.target_line and f.rule in s.rules:
+                    s.used.add(f.rule)
+                    suppressed = True
+        if not suppressed:
+            kept.append(f)
+
+    for sf in project.files:
+        for s in sf.suppressions:
+            for rid in sorted(s.rules):
+                if rid not in known_ids:
+                    kept.append(
+                        Finding(
+                            UNUSED_SUPPRESSION,
+                            sf.rel,
+                            s.comment_line,
+                            f"allow({rid}) names an unknown rule id",
+                        )
+                    )
+                elif rid in run_ids and rid not in s.used:
+                    kept.append(
+                        Finding(
+                            UNUSED_SUPPRESSION,
+                            sf.rel,
+                            s.comment_line,
+                            f"allow({rid}) suppresses nothing — remove it",
+                        )
+                    )
+    return sorted(set(kept))

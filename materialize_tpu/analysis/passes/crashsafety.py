@@ -1,0 +1,79 @@
+"""crash/cancel-safety: broad exception handlers must not swallow
+`CrashPointReached` or cancellation, and exception cleanup must not issue
+durable writes.
+
+`CrashPointReached` (persist/crashpoints.py) derives from BaseException
+precisely so `except Exception` recovery code stays cold during a seeded
+crash — recovery converges via boot replay, not in-process cleanup. That
+contract dies silently the moment someone writes a bare `except:` or
+`except BaseException:` that doesn't re-raise (rule `crash-swallow`), or
+performs blob/consensus mutations inside an `except Exception` cleanup
+block, where a half-applied "undo" can corrupt the very state boot replay
+trusts (rule `durable-cleanup`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import handler_catches, has_bare_reraise, terminal_name
+from ..core import Finding, Project, Rule, SourceFile
+
+_BROAD = {None, "BaseException"}
+_EXC_OR_BROADER = {None, "BaseException", "Exception"}
+#: durable-op method names on blob/consensus receivers
+_DURABLE_METHODS = {"set", "cas", "compare_and_set", "delete", "append_batch"}
+
+
+class CrashSwallow(Rule):
+    id = "crash-swallow"
+    description = (
+        "bare except / except BaseException without a bare re-raise can "
+        "swallow CrashPointReached and cancellation"
+    )
+
+    def check_file(self, sf: SourceFile, project: Project):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if handler_catches(node, _BROAD) and not has_bare_reraise(node):
+                yield Finding(
+                    self.id,
+                    sf.rel,
+                    node.lineno,
+                    "broad handler can swallow CrashPointReached/"
+                    "KeyboardInterrupt — catch Exception, or re-raise with "
+                    "a bare `raise` after cleanup",
+                )
+
+
+class DurableCleanup(Rule):
+    id = "durable-cleanup"
+    description = (
+        "no blob/consensus mutations inside except-Exception cleanup blocks"
+    )
+
+    def check_file(self, sf: SourceFile, project: Project):
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not handler_catches(node, _EXC_OR_BROADER):
+                continue
+            for sub in ast.walk(node):
+                if not (
+                    isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _DURABLE_METHODS
+                ):
+                    continue
+                recv = terminal_name(sub.func.value) or ""
+                if "blob" in recv or "consensus" in recv:
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        sub.lineno,
+                        f"durable op '{recv}.{sub.func.attr}(...)' inside an "
+                        "exception cleanup block — crash recovery must "
+                        "converge via boot replay, not a cleanup that can "
+                        "itself be interrupted",
+                    )

@@ -1,0 +1,69 @@
+"""dtype-64bit: the device hot path stays 32-bit native (the lint_32bit
+scan, registered on the shared framework).
+
+The tick pipeline (ops/, arrangement/, parallel/exchange*.py) carries u32
+hashes, u32 time views, and (hi, lo) u32 sort-key pairs end-to-end; the
+TPU VPU is a 32-bit machine and every stray 64-bit device dtype
+reintroduces X64SplitLow pairs into sorts/probes (the confirmed ~2× tax
+of the r2 profile). Deliberate 64-bit columns are declared ONCE at the
+representation boundary (repr/batch.py: TIME_DTYPE / DIFF_DTYPE /
+I64_DTYPE) — repr/ is therefore NOT scanned.
+"""
+
+from __future__ import annotations
+
+import re
+
+from ..core import Finding, Project, Rule, SourceFile
+
+FORBIDDEN = re.compile(
+    r"""jnp\.(u?int64|float64)\b
+      | jnp\.dtype\(\s*['"]((u?int|float)64)['"]\s*\)
+      | astype\(\s*['"]((u?int|float)64)['"]\s*\)
+    """,
+    re.VERBOSE,
+)
+
+_HOT_PREFIXES = (
+    "materialize_tpu/ops/",
+    "materialize_tpu/arrangement/",
+)
+
+
+def in_scope(rel: str) -> bool:
+    if rel.startswith(_HOT_PREFIXES):
+        return True
+    if rel.startswith("materialize_tpu/parallel/"):
+        base = rel.rsplit("/", 1)[-1]
+        return base.startswith(("exchange", "netexchange"))
+    return False
+
+
+def scan_lines(rel: str, lines: list) -> list:
+    findings = []
+    for lineno, line in enumerate(lines, 1):
+        code = line.split("#", 1)[0]  # comments may cite the tax freely
+        m = FORBIDDEN.search(code)
+        if m:
+            findings.append(
+                Finding(
+                    Dtype64.id,
+                    rel,
+                    lineno,
+                    f"forbidden 64-bit device dtype `{m.group(0)}` in a "
+                    "hot-path module — import TIME_DTYPE/DIFF_DTYPE/"
+                    "I64_DTYPE from materialize_tpu.repr.batch instead",
+                )
+            )
+    return findings
+
+
+class Dtype64(Rule):
+    id = "dtype-64bit"
+    description = "no 64-bit device dtypes in hot-path modules"
+
+    def scope(self, rel: str) -> bool:
+        return in_scope(rel)
+
+    def check_file(self, sf: SourceFile, project: Project):
+        return scan_lines(sf.rel, sf.lines)

@@ -1,0 +1,47 @@
+"""listener-hygiene: every accept loop must be shutdown-capable.
+
+This sandbox's network stack does NOT interrupt a thread blocked in
+``accept()`` when the listening socket is closed (doc/ROADMAP.md known
+facts) — a raw ``while True: srv.accept()`` loop leaks its thread forever
+and can hold the process open. The fix pattern is mechanical, so the rule
+enforces it package-wide (PR 6 scanned only frontend/ + cluster/; new
+subsystems get no grace period): every file that calls ``.accept(`` must
+also (1) ``settimeout(`` the listener, (2) handle ``except
+socket.timeout`` (the periodic wake-up), and (3) handle ``except
+OSError`` (the closed-listener shutdown path). Files using stdlib servers
+(serve_forever is selector-driven) contain no literal ``.accept(`` and
+pass automatically.
+"""
+
+from __future__ import annotations
+
+from ..core import Finding, Project, Rule, SourceFile
+
+REQUIRED = {
+    "listener timeout": "settimeout(",
+    "timeout wake-up handler": "except socket.timeout",
+    "closed-listener shutdown path": "except OSError",
+}
+
+
+def problems_for_text(text: str) -> list[str]:
+    """The missing-needle descriptions for one file's source text."""
+    if ".accept(" not in text:
+        return []
+    return [
+        f"accept loop lacks {what} ({needle!r})"
+        for what, needle in REQUIRED.items()
+        if needle not in text
+    ]
+
+
+class ListenerHygiene(Rule):
+    id = "listener-hygiene"
+    description = "accept loops must time out and survive listener close"
+
+    def scope(self, rel: str) -> bool:
+        return rel.startswith("materialize_tpu/")
+
+    def check_file(self, sf: SourceFile, project: Project):
+        for problem in problems_for_text(sf.text):
+            yield Finding(self.id, sf.rel, 1, problem)

@@ -1,0 +1,298 @@
+"""registry-coherence: the cross-file contracts between declaration sites
+and use sites.
+
+Three rules, one theme — a registry entry nobody consumes (or a consumer
+nobody registered) is rot that only shows up in production:
+
+  dyncfg-coherence    every `Config("name", ...)` declared in
+                      adapter/dyncfg.py is read somewhere by string
+                      literal, and every literal read names a declared
+                      config (so typos fail lint, not KeyError at ALTER
+                      SYSTEM time)
+  sqlstate-coherence  every SqlError subclass carries a well-formed
+                      5-char SQLSTATE, and every literal code handed to
+                      the pgwire error senders is either an engine state
+                      from errors.py or a documented wire-protocol state
+  ctp-coherence       every CTP frame type constructed on the controller
+                      side has an isinstance dispatch arm in clusterd,
+                      every response constructed in clusterd is
+                      isinstance-checked back in the controller, and no
+                      frame type is dead
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import decorator_name, terminal_name
+from ..core import Finding, Project, Rule, SourceFile
+
+# -- dyncfg ------------------------------------------------------------------
+
+#: receiver identifiers that hold a ConfigSet / config snapshot
+_CONFIG_RECEIVERS = {"configs", "config", "cfg", "session", "system", "_cfg"}
+
+
+def _receiver_name(expr: ast.AST) -> str | None:
+    """Terminal identifier of a read receiver; `self._cfg()` -> '_cfg'."""
+    if isinstance(expr, ast.Call):
+        return terminal_name(expr.func)
+    return terminal_name(expr)
+
+
+class DyncfgCoherence(Rule):
+    id = "dyncfg-coherence"
+    description = (
+        "declared dyncfgs must be read somewhere; literal reads must name "
+        "a declared dyncfg"
+    )
+
+    def check_project(self, project: Project):
+        decl_sf = project.find_suffix("adapter/dyncfg.py")
+        if decl_sf is None:
+            return
+        declared: dict = {}  # name -> line
+        for node in ast.walk(decl_sf.tree):
+            if (
+                isinstance(node, ast.Call)
+                and terminal_name(node.func) == "Config"
+                and node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                declared[node.args[0].value] = node.lineno
+
+        reads: dict = {}  # name -> (rel, line) of first read
+        for sf in project.files:
+            if sf is decl_sf or not sf.rel.startswith("materialize_tpu/"):
+                continue
+            for node in ast.walk(sf.tree):
+                name = None
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "get"
+                    and _receiver_name(node.func.value) in _CONFIG_RECEIVERS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    name = node.args[0].value
+                elif (
+                    isinstance(node, ast.Subscript)
+                    and _receiver_name(node.value) in _CONFIG_RECEIVERS
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                ):
+                    name = node.slice.value
+                elif (
+                    isinstance(node, ast.Compare)
+                    and len(node.ops) == 1
+                    and isinstance(node.ops[0], ast.In)
+                    and isinstance(node.left, ast.Constant)
+                    and isinstance(node.left.value, str)
+                    and _receiver_name(node.comparators[0]) in _CONFIG_RECEIVERS
+                ):
+                    name = node.left.value
+                if name is not None:
+                    reads.setdefault(name, (sf.rel, node.lineno))
+
+        for name, (rel, line) in sorted(reads.items()):
+            if name not in declared:
+                yield Finding(
+                    self.id,
+                    rel,
+                    line,
+                    f"config {name!r} is read here but never declared in "
+                    "adapter/dyncfg.py — a typo'd name raises KeyError at "
+                    "runtime",
+                )
+        for name, line in sorted(declared.items()):
+            if name not in reads:
+                yield Finding(
+                    self.id,
+                    decl_sf.rel,
+                    line,
+                    f"config {name!r} is declared but never read — either "
+                    "wire it up or delete the declaration",
+                )
+
+
+# -- sqlstate ----------------------------------------------------------------
+
+_SQLSTATE_RE = re.compile(r"^[0-9A-Z]{5}$")
+#: wire-protocol states the pgwire layer may emit that are NOT engine
+#: errors (no exception class carries them); the pg standard codes for
+#: protocol/extended-query bookkeeping
+_WIRE_STATES = {
+    "08P01",  # protocol_violation
+    "42601",  # syntax_error (multi-statement Parse)
+    "42P05",  # duplicate_prepared_statement
+    "26000",  # invalid_sql_statement_name
+    "34000",  # invalid_cursor_name
+    "0A000",  # feature_not_supported
+}
+_ERROR_SENDERS = {"_send_error", "_ext_error"}
+
+
+class SqlstateCoherence(Rule):
+    id = "sqlstate-coherence"
+    description = (
+        "SqlError subclasses carry well-formed SQLSTATEs; literal codes on "
+        "the wire come from errors.py or the documented protocol set"
+    )
+
+    def check_project(self, project: Project):
+        errors_sf = project.find_suffix("materialize_tpu/errors.py")
+        engine_states: set = set()
+        if errors_sf is not None:
+            sqlerror_classes = {"SqlError"}
+            for node in errors_sf.tree.body:
+                if isinstance(node, ast.ClassDef) and any(
+                    terminal_name(b) in sqlerror_classes for b in node.bases
+                ):
+                    sqlerror_classes.add(node.name)
+                    state = None
+                    for stmt in node.body:
+                        if (
+                            isinstance(stmt, ast.Assign)
+                            and any(
+                                isinstance(t, ast.Name) and t.id == "sqlstate"
+                                for t in stmt.targets
+                            )
+                            and isinstance(stmt.value, ast.Constant)
+                        ):
+                            state = stmt.value.value
+                    if state is not None:
+                        if not _SQLSTATE_RE.match(str(state)):
+                            yield Finding(
+                                self.id,
+                                errors_sf.rel,
+                                node.lineno,
+                                f"{node.name}.sqlstate {state!r} is not a "
+                                "well-formed 5-char SQLSTATE",
+                            )
+                        else:
+                            engine_states.add(state)
+            engine_states.add("XX000")
+
+        for sf in project.files:
+            if not sf.rel.startswith("materialize_tpu/frontend/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if not (
+                    isinstance(node, ast.Call)
+                    and terminal_name(node.func) in _ERROR_SENDERS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                ):
+                    continue
+                code = node.args[0].value
+                if not _SQLSTATE_RE.match(code):
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        node.lineno,
+                        f"malformed SQLSTATE literal {code!r}",
+                    )
+                elif code not in engine_states and code not in _WIRE_STATES:
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        node.lineno,
+                        f"SQLSTATE {code!r} is neither an engine state from "
+                        "errors.py nor a documented wire-protocol state — "
+                        "add the error class (or extend _WIRE_STATES with "
+                        "a comment)",
+                    )
+
+
+# -- CTP ---------------------------------------------------------------------
+
+
+class CtpCoherence(Rule):
+    id = "ctp-coherence"
+    description = (
+        "every CTP frame type sent has a receiver-side isinstance handler; "
+        "no frame type is dead"
+    )
+
+    COMMAND_RECEIVER = "cluster/clusterd.py"
+    RESPONSE_RECEIVER = "cluster/controller.py"
+
+    def check_project(self, project: Project):
+        proto_sf = project.find_suffix("cluster/protocol.py")
+        if proto_sf is None:
+            return
+        frames: dict = {}  # class name -> decl line
+        for node in proto_sf.tree.body:
+            if isinstance(node, ast.ClassDef) and any(
+                decorator_name(d) == "dataclass" for d in node.decorator_list
+            ):
+                frames[node.name] = node.lineno
+        if not frames:
+            return
+
+        constructed: dict = {name: set() for name in frames}
+        checked: dict = {name: set() for name in frames}
+        for sf in project.files:
+            if sf is proto_sf or not sf.rel.startswith("materialize_tpu/"):
+                continue
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Call):
+                    name = terminal_name(node.func)
+                    if name in frames:
+                        constructed[name].add(sf.rel)
+                    if (
+                        isinstance(node.func, ast.Name)
+                        and node.func.id == "isinstance"
+                        and len(node.args) == 2
+                    ):
+                        types = (
+                            node.args[1].elts
+                            if isinstance(node.args[1], ast.Tuple)
+                            else [node.args[1]]
+                        )
+                        for t in types:
+                            tname = terminal_name(t)
+                            if tname in frames:
+                                checked[tname].add(sf.rel)
+
+        for name, line in sorted(frames.items()):
+            built = constructed[name]
+            if not built:
+                yield Finding(
+                    self.id,
+                    proto_sf.rel,
+                    line,
+                    f"frame type {name!r} is never constructed — dead "
+                    "protocol surface",
+                )
+                continue
+            clusterd_builds = {r for r in built if r.endswith(self.COMMAND_RECEIVER)}
+            controller_builds = built - clusterd_builds
+            if controller_builds and not any(
+                r.endswith(self.COMMAND_RECEIVER) for r in checked[name]
+            ):
+                yield Finding(
+                    self.id,
+                    proto_sf.rel,
+                    line,
+                    f"command {name!r} is sent from "
+                    f"{sorted(controller_builds)[0]} but has no isinstance "
+                    f"dispatch arm in {self.COMMAND_RECEIVER}",
+                )
+            if clusterd_builds and not any(
+                r.endswith(self.RESPONSE_RECEIVER) for r in checked[name]
+            ):
+                yield Finding(
+                    self.id,
+                    proto_sf.rel,
+                    line,
+                    f"response {name!r} is sent from {self.COMMAND_RECEIVER} "
+                    "but never isinstance-checked in "
+                    f"{self.RESPONSE_RECEIVER} — an unexpected frame would "
+                    "duck-type its way into an AttributeError",
+                )

@@ -1,0 +1,315 @@
+"""tracer-safety: no host round-trips or data-dependent Python control
+flow on traced values inside the device-kernel modules.
+
+Scope: ops/, dataflow/fused.py, models/ — the code that runs (or is
+staged to run) under `jax.jit`. A lightweight per-function taint analysis
+seeds on the results of `jnp.*`/`lax.*` calls (plus the parameters of
+explicitly-jitted functions, which ARE tracers), propagates through
+arithmetic/subscripts/assignments, and sanitizes through the static
+attributes `.shape`/`.ndim`/`.dtype`/`.size` and `len()` (host ints even
+under trace). Three rules share the engine:
+
+  traced-coercion     int()/bool()/float() or if/while/assert/and/or on a
+                      tainted value — a ConcretizationTypeError under jit,
+                      a silent device->host sync on the eager path
+  traced-np-call      np.* call on a tainted value — silently copies the
+                      device array to host
+  traced-searchsorted jnp.searchsorted anywhere in scope — lowers to a
+                      sequential while_loop on TPU; ops/search.py's
+                      branchless bisection is the sanctioned replacement
+
+Host pulls remain expressible: route them through a named jitted wrapper
+(`total = int(join_total(probe, arr))` — a call to a local function is
+not a taint source), which keeps every deliberate device->host sync
+greppable by name.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted, terminal_name
+from ..core import Finding, Project, Rule, SourceFile
+
+#: namespaces whose call results live on device
+_DEVICE_ROOTS = {"jnp", "lax", "jsp"}
+#: jnp/lax helpers that return host metadata, not arrays
+_HOST_FNS = {
+    "dtype",
+    "result_type",
+    "issubdtype",
+    "iinfo",
+    "finfo",
+    "can_cast",
+    "promote_types",
+    "ndim",
+    "shape",
+}
+#: attribute reads that yield host values even on tracers
+_SANITIZING_ATTRS = {"shape", "ndim", "dtype", "size"}
+_COERCIONS = {"int", "bool", "float"}
+_NP_ROOTS = {"np", "numpy"}
+
+
+def in_scope(rel: str) -> bool:
+    return (
+        rel.startswith("materialize_tpu/ops/")
+        or rel.startswith("materialize_tpu/models/")
+        or rel == "materialize_tpu/dataflow/fused.py"
+    )
+
+
+def _is_device_call(call: ast.Call) -> bool:
+    d = dotted(call.func)
+    if d is None:
+        return False
+    parts = d.split(".")
+    return parts[0] in _DEVICE_ROOTS and parts[-1] not in _HOST_FNS
+
+
+def _jit_static_names(fn: ast.FunctionDef):
+    """(is_jitted, static param names) from the decorator list.
+
+    `@partial(jax.jit, static_argnames=(...))` params are compile-time
+    constants, not tracers — they must not seed taint."""
+    for dec in fn.decorator_list:
+        if dotted(dec) in ("jax.jit", "jit"):
+            return True, set()
+        if isinstance(dec, ast.Call):
+            is_jit = dotted(dec.func) in ("jax.jit", "jit") or (
+                dotted(dec.func) in ("partial", "functools.partial")
+                and dec.args
+                and dotted(dec.args[0]) in ("jax.jit", "jit")
+            )
+            if not is_jit:
+                continue
+            static: set = set()
+            argnames = [
+                a.arg for a in fn.args.posonlyargs + fn.args.args
+            ]
+            for kw in dec.keywords:
+                if kw.arg == "static_argnames":
+                    for elt in ast.walk(kw.value):
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str
+                        ):
+                            static.add(elt.value)
+                elif kw.arg == "static_argnums":
+                    for elt in ast.walk(kw.value):
+                        if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, int
+                        ) and 0 <= elt.value < len(argnames):
+                            static.add(argnames[elt.value])
+            return True, static
+    return False, set()
+
+
+class _Taint:
+    """Per-function forward taint with a small fixpoint over the body."""
+
+    def __init__(self, fn: ast.FunctionDef, jitted: bool, static: set = frozenset()):
+        self.tainted: set = set()
+        if jitted:
+            a = fn.args
+            for arg in a.posonlyargs + a.args + a.kwonlyargs:
+                if arg.arg not in static:
+                    self.tainted.add(arg.arg)
+        # two passes approximate a fixpoint for use-before-def in loops
+        for _ in range(2):
+            before = len(self.tainted)
+            self._propagate(fn)
+            if len(self.tainted) == before:
+                break
+
+    def _propagate(self, fn):
+        for node in _walk_shallow(fn):
+            if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                value = node.value
+                if value is None or not self.expr_tainted(value):
+                    continue
+                targets = (
+                    node.targets
+                    if isinstance(node, ast.Assign)
+                    else [node.target]
+                )
+                for tgt in targets:
+                    self._taint_target(tgt)
+            elif isinstance(node, ast.For) and self.expr_tainted(node.iter):
+                self._taint_target(node.target)
+
+    def _taint_target(self, tgt):
+        if isinstance(tgt, ast.Name):
+            self.tainted.add(tgt.id)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._taint_target(elt)
+        elif isinstance(tgt, ast.Starred):
+            self._taint_target(tgt.value)
+
+    def expr_tainted(self, e: ast.AST) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id in self.tainted
+        if isinstance(e, ast.Call):
+            return _is_device_call(e)
+        if isinstance(e, ast.Attribute):
+            if e.attr in _SANITIZING_ATTRS:
+                return False
+            return self.expr_tainted(e.value)
+        if isinstance(e, ast.Subscript):
+            return self.expr_tainted(e.value)
+        if isinstance(e, ast.BinOp):
+            return self.expr_tainted(e.left) or self.expr_tainted(e.right)
+        if isinstance(e, ast.UnaryOp):
+            return self.expr_tainted(e.operand)
+        if isinstance(e, ast.Compare):
+            # identity checks (`x is not None`) are host-decidable even on
+            # tracers — the canonical optional-argument test
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in e.ops):
+                return False
+            return self.expr_tainted(e.left) or any(
+                self.expr_tainted(c) for c in e.comparators
+            )
+        if isinstance(e, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in e.values)
+        if isinstance(e, ast.IfExp):
+            return self.expr_tainted(e.body) or self.expr_tainted(e.orelse)
+        if isinstance(e, (ast.Tuple, ast.List)):
+            return any(self.expr_tainted(v) for v in e.elts)
+        if isinstance(e, ast.Starred):
+            return self.expr_tainted(e.value)
+        return False
+
+
+def _walk_shallow(fn):
+    """Nodes of `fn`'s own body, NOT descending into nested defs/lambdas
+    (they run under their own trace context and get their own engine)."""
+    work = list(ast.iter_child_nodes(fn))
+    while work:
+        node = work.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        yield node
+        work.extend(ast.iter_child_nodes(node))
+
+
+def _iter_functions(tree):
+    """All function defs (top-level, methods, nested), each paired with
+    whether its OWN decorator list jits it. Nested helpers inside a jitted
+    function do not inherit for param seeding: their parameters are bound
+    at in-trace call sites and are frequently host values (agg specs,
+    scale ints); only the jit entry point's params are certainly tracers.
+    Device values inside nested helpers still taint via jnp-call seeds."""
+    def rec(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                own_jitted, static = _jit_static_names(child)
+                yield child, own_jitted, static
+            yield from rec(child)
+
+    yield from rec(tree)
+
+
+class TracedCoercion(Rule):
+    id = "traced-coercion"
+    description = (
+        "int()/bool()/float() and data-dependent control flow on traced "
+        "values break under jit and force device syncs eagerly"
+    )
+
+    def scope(self, rel: str) -> bool:
+        return in_scope(rel)
+
+    def check_file(self, sf: SourceFile, project: Project):
+        for fn, jitted, static in _iter_functions(sf.tree):
+            taint = _Taint(fn, jitted, static)
+            for node in _walk_shallow(fn):
+                if isinstance(node, (ast.If, ast.While)) and taint.expr_tainted(
+                    node.test
+                ):
+                    kw = "while" if isinstance(node, ast.While) else "if"
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        node.lineno,
+                        f"data-dependent `{kw}` on a traced value — use "
+                        "jnp.where / lax.cond / a masked branchless form",
+                    )
+                elif isinstance(node, ast.IfExp) and taint.expr_tainted(node.test):
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        node.lineno,
+                        "ternary on a traced value — use jnp.where",
+                    )
+                elif isinstance(node, ast.Assert) and taint.expr_tainted(node.test):
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        node.lineno,
+                        "assert on a traced value — hoist to a host-side "
+                        "shape/dtype check",
+                    )
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id in _COERCIONS
+                    and any(taint.expr_tainted(a) for a in node.args)
+                ):
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        node.lineno,
+                        f"{node.func.id}() on a traced value — route the "
+                        "host pull through a named jitted wrapper, or keep "
+                        "it on device",
+                    )
+
+
+class TracedNpCall(Rule):
+    id = "traced-np-call"
+    description = "np.* call on a device value silently copies it to host"
+
+    def scope(self, rel: str) -> bool:
+        return in_scope(rel)
+
+    def check_file(self, sf: SourceFile, project: Project):
+        for fn, jitted, static in _iter_functions(sf.tree):
+            taint = _Taint(fn, jitted, static)
+            for node in _walk_shallow(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                if d is None or d.split(".")[0] not in _NP_ROOTS:
+                    continue
+                if any(taint.expr_tainted(a) for a in node.args):
+                    yield Finding(
+                        self.id,
+                        sf.rel,
+                        node.lineno,
+                        f"'{d}' applied to a device value — use the jnp "
+                        "equivalent, or make the host copy explicit with "
+                        "np.asarray(jax.device_get(...)) at the boundary",
+                    )
+
+
+class TracedSearchsorted(Rule):
+    id = "traced-searchsorted"
+    description = (
+        "jnp.searchsorted lowers to a sequential while_loop on TPU; use "
+        "ops/search.py's branchless bisection"
+    )
+
+    def scope(self, rel: str) -> bool:
+        return in_scope(rel)
+
+    def check_file(self, sf: SourceFile, project: Project):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call) and dotted(node.func) == "jnp.searchsorted":
+                yield Finding(
+                    self.id,
+                    sf.rel,
+                    node.lineno,
+                    "jnp.searchsorted is banned on the hot path — call "
+                    "materialize_tpu.ops.search.searchsorted_u32 (branchless, "
+                    "fixed trip count) instead",
+                )

@@ -510,9 +510,13 @@ class ShardedComputeController:
         self._misses = [0] * len(self.shard_addrs)
         self._rng = random.Random()  # backoff jitter only
         # serializes command fan-out against reform: a reform must never tear
-        # sockets out from under an in-flight fan-out, and concurrent healers
-        # (heartbeat thread + a failing command's retry path) must collapse
+        # sockets out from under an in-flight fan-out
         self._cmd_lock = threading.RLock()
+        # serializes healers (heartbeat thread + failing commands' retry
+        # paths) so they collapse into one reform; held across the probe/
+        # backoff sleeps, which is why it is a separate lock — commands only
+        # contend on _cmd_lock and never stall behind a heal's backoff
+        self._heal_lock = threading.RLock()
         self._hb_stop = threading.Event()
         self._hb_thread: threading.Thread | None = None
         self._connect_and_form()
@@ -532,11 +536,17 @@ class ShardedComputeController:
     def n_workers(self) -> int:
         return self.n_processes * self.workers_per_process
 
+    def _epoch(self) -> int:
+        """Guarded epoch snapshot for the lock-free paths (heartbeats,
+        retry bookkeeping); reform bumps the epoch under _cmd_lock."""
+        with self._cmd_lock:
+            return self.epoch
+
     # -- mesh lifecycle ----------------------------------------------------
     def _new_client(self, i: int) -> ReplicaClient:
         return ReplicaClient(
             self.shard_addrs[i],
-            self.epoch,
+            self._epoch(),
             label=f"shard{i}",
             deadlines=self.deadlines,
         )
@@ -554,7 +564,7 @@ class ShardedComputeController:
         resps = self._request_all(
             [
                 p.FormMesh(
-                    self.epoch,
+                    self._epoch(),
                     i,
                     self.n_processes,
                     self.workers_per_process,
@@ -596,19 +606,24 @@ class ShardedComputeController:
                          max_attempts: int | None = None) -> bool:
         """Self-healing: restart unreachable shard processes (when a
         `restart_shard` hook was given), then reform at a bumped epoch.
-        Concurrent healers collapse: whoever holds the lock first does the
-        work, later entrants see the advanced epoch and return."""
+        Concurrent healers collapse: whoever holds the heal lock first does
+        the work, later entrants see the advanced epoch and return. Probes,
+        restarts and backoff sleeps run under _heal_lock only — _cmd_lock is
+        taken just for the short state checks/mutations, so command fan-out
+        never queues behind a heal's backoff."""
         attempts = max_attempts if max_attempts is not None else 1 + self.retries
-        with self._cmd_lock:
-            if self.epoch > failure_epoch and not self.degraded:
-                return True  # another path already reformed past the failure
-            if not self.degraded:
-                self.degraded = True
-                self.events.append(("degraded", failure_epoch, reason))
+        with self._heal_lock:
+            with self._cmd_lock:
+                if self.epoch > failure_epoch and not self.degraded:
+                    return True  # another healer already reformed past it
+                if not self.degraded:
+                    self.degraded = True
+                    self.events.append(("degraded", failure_epoch, reason))
             for attempt in range(attempts):
                 for i in range(self.n_processes):
                     if not self._reachable(i):
-                        self.events.append(("restart", i))
+                        with self._cmd_lock:
+                            self.events.append(("restart", i))
                         if self.restart_shard is not None:
                             try:
                                 self.restart_shard(i)
@@ -618,7 +633,10 @@ class ShardedComputeController:
                     self.reform()
                     return True
                 except (ConnectionError, OSError, RuntimeError) as e:
-                    self.events.append(("reform-failed", self.epoch, str(e)[:200]))
+                    with self._cmd_lock:
+                        self.events.append(
+                            ("reform-failed", self.epoch, str(e)[:200])
+                        )
                     if attempt < attempts - 1:
                         time.sleep(backoff_delay(attempt, rng=self._rng))
             return False
@@ -692,7 +710,7 @@ class ShardedComputeController:
         attempts = 1 + (self.retries if isinstance(cmd, IDEMPOTENT_COMMANDS) else 0)
         last: Exception | None = None
         for attempt in range(attempts):
-            failure_epoch = self.epoch
+            failure_epoch = self._epoch()
             try:
                 with self._cmd_lock:
                     resps = self._request_all([cmd] * self.n_processes)
@@ -734,7 +752,9 @@ class ShardedComputeController:
         only complete once every partition has processed it)."""
         resps = self._broadcast(p.ProcessTo(0), record=False)
         merged: dict = {}
-        for resp in resps:
+        for i, resp in enumerate(resps):
+            if not isinstance(resp, p.Frontiers):
+                raise RuntimeError(f"shard {i}: unexpected {resp!r}")
             for df_id, upper in resp.uppers.items():
                 cur = merged.get(df_id)
                 merged[df_id] = upper if cur is None else min(cur, upper)
@@ -765,12 +785,12 @@ class ShardedComputeController:
                     # heal: the read path must, or degraded latches forever
                     # on a read-only workload even after the fault clears
                     self._heal_and_reform(
-                        self.epoch, "peek: re-arming reform", max_attempts=1
+                        self._epoch(), "peek: re-arming reform", max_attempts=1
                     )
                 else:
                     self._await_healthy()
             uid = uuidlib.uuid4().hex  # fresh nonce per attempt
-            failure_epoch = self.epoch
+            failure_epoch = self._epoch()
             try:
                 with self._cmd_lock:
                     resps = self._request_all(
@@ -867,7 +887,7 @@ class ShardedComputeController:
             # keep re-arming one reform attempt per beat until it sticks —
             # a permanently-degraded replica would be a liveness bug
             self._heal_and_reform(
-                self.epoch, "still degraded: re-arming reform", max_attempts=1
+                self._epoch(), "still degraded: re-arming reform", max_attempts=1
             )
             return [self.degraded is False] * self.n_processes
         alive: list[bool] = []
@@ -885,7 +905,7 @@ class ShardedComputeController:
                 if pong == "busy":
                     alive.append(True)
                     continue
-                ok = isinstance(pong, p.Pong) and pong.mesh_epoch == self.epoch
+                ok = isinstance(pong, p.Pong) and pong.mesh_epoch == self._epoch()
                 if not ok:
                     r.close()
                     # a live process with a stale/absent mesh re-dials fine
@@ -895,16 +915,21 @@ class ShardedComputeController:
                     except (ConnectionError, OSError):
                         pass
             if ok:
-                self._misses[i] = 0
+                with self._cmd_lock:
+                    self._misses[i] = 0
                 self.last_pong[i] = time.time()
                 _HEARTBEAT_RTT.set(time.perf_counter() - t0, target=r.label)
             else:
-                self._misses[i] += 1
+                with self._cmd_lock:
+                    self._misses[i] += 1
             alive.append(ok)
-        dead = [i for i, m in enumerate(self._misses) if m >= self.miss_threshold]
+        with self._cmd_lock:
+            dead = [
+                i for i, m in enumerate(self._misses) if m >= self.miss_threshold
+            ]
         if dead and not self.degraded:
             self._heal_and_reform(
-                self.epoch,
+                self._epoch(),
                 f"shards {dead} missed {self.miss_threshold} heartbeats",
             )
         return alive

@@ -221,12 +221,21 @@ class WorkerMesh:
                 conn.close()
                 return
             _tag, epoch, from_process = frame
+            # decide under the lock, reply outside it: a slow dialer must
+            # not stall form()/exchange() behind our handshake write
+            with self._lock:
+                fenced_at = self.epoch if epoch < self.epoch else None
+            if fenced_at is not None:
+                p.send_frame(conn, ("fenced", fenced_at))
+                conn.close()
+                return
+            p.send_frame(conn, ("ok", epoch))
             with self._lock:
                 if epoch < self.epoch:
-                    p.send_frame(conn, ("fenced", self.epoch))
+                    # epoch advanced while we replied; the dialer's form()
+                    # is doomed to be fenced anyway — drop the socket
                     conn.close()
                     return
-                p.send_frame(conn, ("ok", epoch))
                 # stash until the local form() for this epoch adopts it —
                 # the dialer may handshake before OUR FormMesh arrives
                 self._pending.setdefault(epoch, {})[from_process] = conn
@@ -281,13 +290,13 @@ class WorkerMesh:
         for j in range(process_index):
             sock = self._dial(peer_addrs[j], epoch, deadline)
             with self._lock:
-                self._adopt(j, sock)
+                self._adopt_locked(j, sock)
         with self._lock:
             expect = set(range(process_index + 1, n_processes))
             while expect - set(self._conns):
                 got = self._pending.get(epoch, {})
                 for j in list(expect & set(got)):
-                    self._adopt(j, got.pop(j))
+                    self._adopt_locked(j, got.pop(j))
                 if not (expect - set(self._conns)):
                     break
                 remaining = deadline - _time.time()
@@ -320,13 +329,17 @@ class WorkerMesh:
                 _time.sleep(0.05)
         raise MeshError(f"cannot reach mesh peer {addr}: {last}")
 
-    def _adopt(self, peer: int, sock: socket.socket) -> None:
+    def _adopt_locked(self, peer: int, sock: socket.socket) -> None:
         """Register a handshaken connection and start its receiver (lock held)."""
         sock.settimeout(None)
         self._conns[peer] = sock
         self._send_locks[peer] = threading.Lock()
+        # snapshot epoch/index while the lock is held: the receiver thread
+        # must never touch controller-guarded state directly
         threading.Thread(
-            target=self._recv_loop, args=(peer, sock, self.epoch), daemon=True
+            target=self._recv_loop,
+            args=(peer, sock, self.epoch, self.process_index),
+            daemon=True,
         ).start()
 
     def _link(self, peer: int) -> tuple:
@@ -335,8 +348,10 @@ class WorkerMesh:
         return (f"proc{self.process_index}", f"proc{peer}")
 
     # -- data plane --------------------------------------------------------
-    def _recv_loop(self, peer: int, sock: socket.socket, epoch: int) -> None:
-        link = (f"proc{peer}", f"proc{self.process_index}")
+    def _recv_loop(
+        self, peer: int, sock: socket.socket, epoch: int, my_index: int
+    ) -> None:
+        link = (f"proc{peer}", f"proc{my_index}")
         try:
             while True:
                 frame = p.recv_frame(sock, link=link)
@@ -376,10 +391,14 @@ class WorkerMesh:
         assert len(parts) == n, f"need {n} parts, got {len(parts)}"
         if timeout is None:
             timeout = self.exchange_timeout
-        epoch = self.epoch
+        # snapshot the topology under the lock: a concurrent reform must not
+        # be able to hand us epoch N's index with epoch N+1's connections
+        with self._lock:
+            epoch = self.epoch
+            my_index = self.process_index
         for dst in range(n):
             proc = self.process_of(dst)
-            if proc == self.process_index:
+            if proc == my_index:
                 self.inbox.deliver(epoch, dst, channel, tick, worker, parts[dst])
                 continue
             frame = ("data", epoch, channel, tick, worker, dst, parts[dst])

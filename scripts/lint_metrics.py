@@ -1,139 +1,51 @@
 #!/usr/bin/env python
-"""Lint: every counter the engine maintains must be observable.
+"""Thin shim over `materialize_tpu.analysis` — the metrics-coherence rule.
 
-Two kinds of silent observability rot this guards against:
-
-1. a counter bumped somewhere in the engine — an OverloadStats
-   ``bump()``/``record_max()`` literal, a trace-manager sharing stat, a
-   persist/mesh/controller registry family — that never shows up in the
-   ``/metrics`` exposition: the decision happened, nobody can see it;
-2. an ``INTROSPECTION_TABLES`` entry whose populator is missing or emits rows
-   of the wrong arity — the catalog advertises a relation that faults (or
-   lies) the day someone actually selects from it.
-
-The check is functional, not purely textual: it boots an in-memory
-coordinator, drives one table + materialized view + peek through it, greps
-the source tree for counter-name literals, then renders ``metrics_text()``
-and materializes every introspection relation through real SQL.
-
-Run: python scripts/lint_metrics.py   (exit 1 on violations; wrapped as a
-tier-1 test in tests/test_lint_metrics.py so CI enforces it).
+The functional check itself (boot a Coordinator, run real SQL, render the
+/metrics exposition, cross-check every bumped counter and every
+INTROSPECTION_TABLES arity) lives in
+materialize_tpu/analysis/passes/metrics_rule.py; this wrapper keeps the
+historical CLI (`env JAX_PLATFORMS=cpu python scripts/lint_metrics.py`)
+and the `lint()` / `overload_counter_names()` / `sharing_counter_names()`
+API that tests/test_lint_metrics.py exercises. Prefer
+`python -m materialize_tpu.analysis --rules metrics-coherence` directly.
 """
 
 from __future__ import annotations
 
-import os
-import re
 import sys
-import threading
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
-PKG = REPO / "materialize_tpu"
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
 
-# registry families registered at module import by the subsystems the issue
-# names: persist op latencies/counters, mesh exchange volume, controller
-# heartbeat RTTs, coordinator tick histograms. render() emits HELP/TYPE even
-# for families with no samples yet, so absence here means the registration
-# itself was dropped.
-REQUIRED_FAMILIES = (
-    "mzt_persist_ops_total",
-    "mzt_persist_op_duration_ns",
-    "mzt_persist_blob_bytes_total",
-    "mzt_mesh_exchange_frames_total",
-    "mzt_mesh_exchange_bytes_total",
-    "mzt_heartbeat_rtt_seconds",
-    "mzt_dataflow_tick_duration_ns",
+from materialize_tpu.analysis.passes.metrics_rule import (  # noqa: E402
+    REQUIRED_FAMILIES,
+    overload_counter_names as _overload_counter_names,
+    sharing_counter_names as _sharing_counter_names,
+    lint as _lint,
 )
 
-_BUMP = re.compile(r'(?:\.bump|\.record_max)\(\s*"([a-z_]+)"')
-_SHARING = re.compile(r'self\.stats\[\s*"([a-z_]+)"\s*\]')
+__all__ = [
+    "REQUIRED_FAMILIES",
+    "overload_counter_names",
+    "sharing_counter_names",
+    "lint",
+    "main",
+]
 
 
 def overload_counter_names() -> set[str]:
-    """Every OverloadStats counter name bumped anywhere in the package."""
-    names: set[str] = set()
-    for path in sorted(PKG.rglob("*.py")):
-        names.update(_BUMP.findall(path.read_text()))
-    return names
+    return _overload_counter_names(REPO)
 
 
 def sharing_counter_names() -> set[str]:
-    return set(_SHARING.findall((PKG / "arrangement" / "trace_manager.py").read_text()))
+    return _sharing_counter_names(REPO)
 
 
 def lint() -> list[str]:
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    sys.path.insert(0, str(REPO))
-
-    # import the subsystems whose module-level registrations we assert on
-    import materialize_tpu.cluster.controller  # noqa: F401
-    import materialize_tpu.cluster.mesh  # noqa: F401
-    import materialize_tpu.persist.location  # noqa: F401
-    from materialize_tpu.adapter import Coordinator
-    from materialize_tpu.adapter.introspection import (
-        INTROSPECTION_TABLES,
-        introspection_rows,
-    )
-    from materialize_tpu.frontend.http_server import metrics_text
-
-    violations: list[str] = []
-    coord = Coordinator()
-    coord.execute("CREATE TABLE lint_t (a int)")
-    coord.execute("INSERT INTO lint_t VALUES (1), (2)")
-    coord.execute(
-        "CREATE MATERIALIZED VIEW lint_mv AS"
-        " SELECT a, count(*) AS n FROM lint_t GROUP BY a"
-    )
-    coord.execute("SELECT * FROM lint_mv")
-
-    # seed every statically-known overload counter at 0 so the exposition
-    # must carry it even before the first real bump
-    for name in sorted(overload_counter_names()):
-        coord.overload.bump(name, 0)
-
-    text = metrics_text(coord, threading.Lock())
-
-    for name in sorted(overload_counter_names()):
-        if f'mzt_overload_counter{{name="{name}"}}' not in text:
-            violations.append(
-                f"overload counter {name!r} is bumped in the source but absent "
-                "from the /metrics exposition (mzt_overload_counter)"
-            )
-    for name in sorted(sharing_counter_names()):
-        if f'mzt_trace_sharing_counter{{name="{name}"}}' not in text:
-            violations.append(
-                f"trace-sharing counter {name!r} is maintained by the trace "
-                "manager but absent from /metrics (mzt_trace_sharing_counter)"
-            )
-    for fam in REQUIRED_FAMILIES:
-        if f"# TYPE {fam} " not in text:
-            violations.append(
-                f"registry family {fam!r} missing from /metrics — its "
-                "registering module was dropped or the name changed"
-            )
-
-    for name, desc in sorted(INTROSPECTION_TABLES.items()):
-        arity = len(desc.columns)
-        try:
-            rows = introspection_rows(coord, name)
-        except Exception as e:  # missing/broken populator
-            violations.append(f"{name}: populator raised {type(e).__name__}: {e}")
-            continue
-        for r in rows:
-            if len(r) != arity:
-                violations.append(
-                    f"{name}: populator row arity {len(r)} != declared "
-                    f"schema arity {arity} (row: {r!r})"
-                )
-                break
-        try:  # the full SQL path: virtual collection snapshot + decode
-            coord.execute(f"SELECT * FROM {name}")
-        except Exception as e:
-            violations.append(
-                f"{name}: SELECT * faulted with {type(e).__name__}: {e}"
-            )
-    return violations
+    return _lint(REPO)
 
 
 def main() -> int:
