@@ -58,9 +58,20 @@ def cmd_serve(args) -> None:
         def ticker():
             while True:
                 time.sleep(args.advance_every)
+                if coord.deploy_state == "fenced":
+                    # a newer generation took over (0dt): demote silently —
+                    # every further advance would just hit the fence and
+                    # spam errors until process exit. Reads keep serving.
+                    print(
+                        "fenced by a newer generation; ticker stopped "
+                        "(read-only until shutdown)",
+                        file=sys.stderr,
+                    )
+                    return
                 try:
                     with httpd.RequestHandlerClass.lock:
-                        coord.advance(args.rows)
+                        if coord.deploy_state == "leader":
+                            coord.advance(args.rows)
                 except Exception as e:  # keep serving
                     print(f"advance error: {e}", file=sys.stderr)
 

@@ -220,6 +220,11 @@ class Coordinator:
         # become "fenced" when a newer generation takes over.
         self.deploy_state = "init"
         self.epoch = 0
+        # egress plane (materialize_tpu/egress): push SUBSCRIBE queues and
+        # exactly-once file sinks, both fed by _apply_writes' egress tick
+        self.subscriptions: dict[str, Any] = {}
+        self.sinks: dict[str, Any] = {}
+        self._sub_seq = 0
         self._register_introspection()
         if self.durable:
             self._boot(read_only=preflight)
@@ -362,6 +367,8 @@ class Coordinator:
             return self._drop(stmt)
         if isinstance(stmt, ast.Subscribe):
             return self._subscribe(stmt)
+        if isinstance(stmt, ast.CreateSink):
+            return self._create_sink(stmt)
         if isinstance(stmt, ast.SetVariable):
             target = (
                 self.configs
@@ -428,49 +435,265 @@ class Coordinator:
         out.copy_data = buf.getvalue()
         return out
 
-    # -- subscriptions ---------------------------------------------------------
+    # -- egress: subscriptions + sinks ----------------------------------------
     def _subscribe(self, stmt: ast.Subscribe) -> ExecResult:
-        """SUBSCRIBE: stream a collection's update triples (reference:
-        src/compute/src/sink/subscribe.rs). Returns a subscription id; poll
-        with `poll_subscription` for (data…, ts, diff) deltas."""
+        """SUBSCRIBE: tap a collection's changelog (reference:
+        src/compute/src/sink/subscribe.rs). Registers a push `Subscription`
+        (egress/subscribe.py) fed at every commit tick; pgwire streams it as
+        COPY-out rows and the HTTP server as NDJSON, while
+        `poll_subscription` remains the pull shape."""
+        from ..egress import Subscription
+
         pq = self.planner.plan_query(stmt.query)
         rel = optimize(pq.mir, self.configs)
-        if isinstance(rel, mir.MirGet) and any(
-            g == rel.id for g, _df, _s in self.dataflows
-        ) or (isinstance(rel, mir.MirGet) and rel.id in self.storage):
+        hidden = None
+        if isinstance(rel, mir.MirGet) and (
+            any(g == rel.id for g, _df, _s in self.dataflows)
+            or rel.id in self.storage
+        ):
             gid = rel.id
         else:
             # materialize the query under a hidden name, then tail it
-            n = len(getattr(self, "subscriptions", {}))
-            name = f"_sub_{n}"
-            self.execute_stmt(
-                ast.CreateMaterializedView(name, stmt.query)
+            hidden = f"_sub_{self._sub_seq}"
+            self.execute_stmt(ast.CreateMaterializedView(hidden, stmt.query))
+            gid = self.catalog.get(hidden).global_id
+        sub_id = f"sub{self._sub_seq}"
+        self._sub_seq += 1
+        obj_name = hidden or next(
+            (it.name for it in self.catalog.items.values() if it.global_id == gid),
+            gid,
+        )
+        sub = Subscription(
+            sub_id, gid, obj_name, pq,
+            tuple(c.name for c in pq.desc.columns),
+            snapshot=stmt.snapshot, progress=stmt.progress,
+            max_depth=int(self._cfg().get("subscribe_queue_depth")),
+            hidden_mv=hidden,
+        )
+        as_of = self.oracle.read_ts()
+        updates = []
+        if stmt.snapshot:
+            updates = self._batch_updates(
+                self.storage[gid].snapshot(as_of),
+                lambda r: self._decode_row(r, pq),
             )
-            gid = self.catalog.get(name).global_id
-        if not hasattr(self, "subscriptions"):
-            self.subscriptions = {}
-        sub_id = f"sub{len(self.subscriptions)}"
-        self.subscriptions[sub_id] = {
-            "gid": gid,
-            "frontier": 0,
-            "pq": pq,
-        }
-        return ExecResult("status", status=sub_id)
+        sub.frontier = as_of + 1
+        if updates or stmt.progress:
+            sub.publish(updates, progress_ts=(as_of + 1) if stmt.progress else None)
+        self.subscriptions[sub_id] = sub
+        out = ExecResult("subscribe", status=sub_id, columns=sub.columns)
+        out.subscription = sub
+        return out
 
     def poll_subscription(self, sub_id: str):
-        """New updates since the last poll: ([(data…, ts, diff)], frontier)."""
+        """Drain queued updates: ([(row…, ts, diff)], frontier) — the HTTP
+        long-poll shape; progress markers are push-stream only."""
         sub = self.subscriptions[sub_id]
-        store = self.storage[sub["gid"]]
-        frontier = sub["frontier"]
-        upper = store.upper
-        rows = []
-        if upper > frontier and store.arr.batches:
-            for data, t, d in store.arr.rows_host():
-                if frontier <= t < upper:
-                    rows.append((self._decode_row(data, sub["pq"]), int(t), int(d)))
-        sub["frontier"] = upper
-        rows.sort(key=lambda r: (r[1], r[0]))
-        return rows, upper
+        rows = [
+            (row, ts, d)
+            for ts, progressed, d, row in sub.drain()
+            if not progressed
+        ]
+        rows.sort(key=lambda r: r[1])
+        return rows, sub.frontier
+
+    def teardown_subscription(self, sub_id: str, state: str = "cancelled") -> None:
+        """Remove a subscription and release what it holds: its compaction
+        read hold (it leaves the hold scan) and, for an ad-hoc query, the
+        hidden _sub_N materialized view — whose drop releases the shared
+        trace holds the render registered."""
+        sub = self.subscriptions.pop(sub_id, None)
+        if sub is None:
+            return
+        sub.close(state)
+        if sub.hidden_mv is not None and sub.hidden_mv in self.catalog.items:
+            self._drop(
+                ast.DropObject("materialized view", sub.hidden_mv, if_exists=True)
+            )
+
+    def _batch_updates(self, batch, decode) -> list:
+        """Consolidated, decoded `(ts, diff, row)` triples from a device
+        batch; numpy scalars are normalized so rows are JSON-encodable."""
+        if batch is None or not int(batch.count()):
+            return []
+        h = consolidate(batch).to_host()
+        out = []
+        for i in range(len(h["times"])):
+            raw = tuple(col[i] for col in h["vals"])
+            row = tuple(
+                v.item() if hasattr(v, "item") else v for v in decode(raw)
+            )
+            out.append((int(h["times"][i]), int(h["diffs"][i]), row))
+        return out
+
+    def _decode_desc_row(self, row: tuple, desc: RelationDesc) -> tuple:
+        """Decode an encoded host row against a RelationDesc — the egress
+        decode path (sinks carry a catalog desc, not a planned-query scope)."""
+        from ..expr.scalar import is_null_value
+
+        out = []
+        for v, c in zip(row, desc.columns):
+            if is_null_value(v, c.typ):
+                out.append(None)
+            elif c.typ in (ColType.STRING, ColType.JSONB):
+                out.append(self.catalog.dict.decode(int(v)))
+            elif c.typ == ColType.NUMERIC and c.scale:
+                out.append(v / (10**c.scale))
+            elif c.typ == ColType.BOOL:
+                out.append(bool(v))
+            else:
+                out.append(v)
+        return tuple(out)
+
+    def _create_sink(self, stmt: ast.CreateSink) -> ExecResult:
+        """CREATE SINK … INTO FILE: catalog the sink, start its changelog at
+        byte 0, and emit the source's existing history as the first frame —
+        through the same exactly-once protocol as steady state, so a crash
+        anywhere inside CREATE converges at the next boot's resume."""
+        from ..egress import FileSink, progress_shard_id
+
+        src = self.catalog.get(stmt.from_name)
+        if src.kind not in ("table", "source", "materialized_view"):
+            raise PlanError(
+                f"CREATE SINK FROM {stmt.from_name}: need a table, source, "
+                f"or materialized view, not a {src.kind}"
+            )
+        item = self.catalog.create(
+            CatalogItem(
+                stmt.name, "sink", desc=src.desc,
+                options=(
+                    ("from", stmt.from_name),
+                    ("path", stmt.path),
+                    ("format", stmt.format),
+                ),
+            )
+        )
+        sink = FileSink(
+            item.global_id, stmt.name, stmt.from_name, src.global_id,
+            stmt.path, stmt.format, src.desc,
+        )
+        with open(stmt.path, "wb"):
+            pass  # the sink owns its changelog from byte 0
+        self.sinks[item.global_id] = sink
+        self._persist_catalog()
+        if self.durable:
+            # history so far = the source shard's contents; emitting it via
+            # resume makes CREATE identical to the boot repair path
+            sink.resume(
+                self._shard(progress_shard_id(item.global_id)),
+                lambda lo, hi, s=sink: self._sink_derive(s, lo, hi),
+                epoch=self.epoch,
+                order=str(self.configs.get("sink_commit_order")),
+            )
+        else:
+            store = self.storage[src.global_id]
+            updates = []
+            if store.arr.batches:
+                updates = self._batch_updates(
+                    store.arr.merged(),
+                    lambda r, s=sink: self._decode_desc_row(r, s.desc),
+                )
+            sink.emit(updates, store.upper)
+        return ExecResult("status", status="CREATE SINK")
+
+    def _register_sink(self, item: CatalogItem, resume: bool = True) -> None:
+        """Rebuild a FileSink from its catalog options at boot. `resume`
+        runs the exactly-once repair + catch-up (leaders-to-be only: a
+        read-only generation loads the durable cursor without touching the
+        changelog file)."""
+        from ..egress import FileSink, progress_shard_id
+
+        opts = dict(item.options)
+        src = self.catalog.get(opts["from"])
+        sink = FileSink(
+            item.global_id, item.name, src.name, src.global_id,
+            opts["path"], opts["format"], item.desc,
+        )
+        self.sinks[item.global_id] = sink
+        m = self._shard(progress_shard_id(item.global_id))
+        if resume:
+            # epoch=None: pre-leadership, like _reconcile_mv_shard
+            sink.resume(
+                m,
+                lambda lo, hi, s=sink: self._sink_derive(s, lo, hi),
+                order=str(self.configs.get("sink_commit_order")),
+            )
+        else:
+            row, _upper = sink.read_register(m)
+            if row is not None:
+                sink.offset, sink.frontier = row[1], row[3]
+
+    def _sink_derive(self, sink, lo_ts: int, hi_ts):
+        """Decoded source updates with lo_ts ≤ time < hi_ts from the durable
+        shard (hi_ts None = everything committed), for sink frame
+        (re-)derivation. Returns `(updates, upper)`."""
+        m = self._shard(sink.from_gid)
+        payloads, upper = m.listen_from(lo_ts)
+        ncols = len(sink.desc.columns)
+        updates = []
+        for cols in payloads:
+            for i in range(len(cols["times"])):
+                t = int(cols["times"][i])
+                if t < lo_ts or (hi_ts is not None and t >= hi_ts):
+                    continue
+                raw = tuple(cols[f"c{j}"][i] for j in range(ncols))
+                updates.append(
+                    (t, int(cols["diffs"][i]), self._decode_desc_row(raw, sink.desc))
+                )
+        return updates, (upper if hi_ts is None else hi_ts)
+
+    def _egress_tick(self, env: dict, ts: int, persist: bool) -> None:
+        """Feed the egress plane one commit tick: push each live
+        subscription's decoded deltas (+ PROGRESS marker), then append each
+        file sink's frame with its durable progress commit (egress/sink.py
+        protocol). Runs after the tick's shard writes, so a crash here never
+        leaves a sink ahead of its source shard."""
+        from ..egress import progress_shard_id
+        from ..persist import Fenced
+
+        for sub_id, sub in list(self.subscriptions.items()):
+            batch = env.get(sub.gid)
+            updates = (
+                self._batch_updates(
+                    batch, lambda r, s=sub: self._decode_row(r, s.pq)
+                )
+                if batch is not None
+                else []
+            )
+            if not updates and not sub.progress:
+                sub.frontier = ts + 1
+                continue
+            if sub.publish(updates, progress_ts=(ts + 1) if sub.progress else None):
+                sub.frontier = ts + 1
+            else:
+                # shed (queue overflow) or closed under us: release the read
+                # hold now; the frontend reports 53400 on its next drain
+                if sub.state == "shed":
+                    self.overload.bump("subscribe_sheds")
+                self.teardown_subscription(sub_id, state=sub.state)
+        if not self.sinks:
+            return
+        emit_durable = persist and self.durable and self.deploy_state == "leader"
+        if self.durable and not emit_durable:
+            return  # read-only generations never touch a changelog
+        order = str(self.configs.get("sink_commit_order"))
+        for gid, sink in self.sinks.items():
+            batch = env.get(sink.from_gid)
+            if batch is None:
+                continue
+            updates = self._batch_updates(
+                batch, lambda r, s=sink: self._decode_desc_row(r, s.desc)
+            )
+            try:
+                sink.emit(
+                    updates, ts + 1,
+                    self._shard(progress_shard_id(gid)) if emit_durable else None,
+                    epoch=self.epoch if emit_durable else None,
+                    order=order,
+                )
+            except Fenced:
+                self.deploy_state = "fenced"
+                raise
 
     # -- DDL -------------------------------------------------------------------
     def _create_table(self, stmt: ast.CreateTable) -> ExecResult:
@@ -782,6 +1005,18 @@ class Coordinator:
                 self.file_sources = [
                     e for e in self.file_sources if e[1] != item.global_id
                 ]
+            # egress teardown: subscriptions tailing the dropped collection
+            # end cleanly; a sink riding on it is dropped with it (its
+            # progress shard stays — orphaned history is harmless)
+            for sid, sub in list(self.subscriptions.items()):
+                if sub.gid == item.global_id:
+                    self.subscriptions.pop(sid, None)
+                    sub.close("dropped")
+            self.sinks.pop(item.global_id, None)
+            for dep_name, dep in list(self.catalog.items.items()):
+                if dep.kind == "sink" and dict(dep.options).get("from") == item.name:
+                    self.catalog.items.pop(dep_name, None)
+                    self.sinks.pop(dep.global_id, None)
         self._persist_catalog()
         return ExecResult("status", status=f"DROP {stmt.kind.upper()}")
 
@@ -1142,6 +1377,7 @@ class Coordinator:
         self.catalog._ids = itertools.count(doc["next_id"])
         self.generators = pickle.loads(doc["generators"])
         mvs = []
+        sink_items = []
         gen_gids: dict[str, str] = {}
         for d in doc["items"]:
             item = CatalogItem(
@@ -1160,6 +1396,8 @@ class Coordinator:
                 item.mir = self.planner.plan_query(item.query_ast)
             elif item.kind == "materialized_view":
                 mvs.append(item)
+            elif item.kind == "sink":
+                sink_items.append(item)
         # regenerate generator gid maps from table names (stored order kept)
         for gen, gids in self.generators:
             for t in list(gids):
@@ -1202,6 +1440,10 @@ class Coordinator:
                                 )
                 else:
                     df.frontier = ts + 1
+        # sinks last: resume's re-derivation reads source shards, which are
+        # final only after MV reconciliation and the temporal fix-ups above
+        for item in sink_items:
+            self._register_sink(item, resume=not read_only)
 
     def _rehydrate_collection(self, gid: str) -> None:
         from ..persist import ShardMachine
@@ -1333,9 +1575,15 @@ class Coordinator:
                 break
         else:
             raise RuntimeError("leader CAS contention")
+        from ..egress import progress_shard_id
+
         for item in self.catalog.items.values():
             if item.kind in ("table", "source", "materialized_view"):
                 self._shard(item.global_id).fence(self.epoch)
+            elif item.kind == "sink":
+                # sink progress registers are commit points too: fence them
+                # so a zombie generation cannot double-commit a frame
+                self._shard(progress_shard_id(item.global_id)).fence(self.epoch)
         if self.durable:
             # the txns shard is a commit point too: fence it so a zombie
             # generation's multi-shard commit fails at its linearization CAS
@@ -1375,8 +1623,21 @@ class Coordinator:
     def promote(self) -> None:
         """Finish a 0dt handoff: final catch-up, then take leadership
         (ReadyToPromote → IsLeader)."""
+        from ..egress import progress_shard_id
+
         self.catch_up()
         self._take_leadership()
+        # egress catch-up: frames for ticks the old leader committed while
+        # this generation was read-only. Sinks only emit as leader, so this
+        # closes the [sink.frontier, source upper) gap exactly once — the
+        # per-tick emit below assumes frontier is always current
+        for gid, sink in self.sinks.items():
+            sink.resume(
+                self._shard(progress_shard_id(gid)),
+                lambda lo, hi, s=sink: self._sink_derive(s, lo, hi),
+                epoch=self.epoch,
+                order=str(self.configs.get("sink_commit_order")),
+            )
 
     # -- write propagation -----------------------------------------------------
     def _apply_writes(
@@ -1479,6 +1740,18 @@ class Coordinator:
                 self._persist_batches(derived, ts)
             if len(self.catalog.dict) != getattr(self, "_persisted_dict_len", -1):
                 self._persist_catalog()
+        if self.subscriptions or self.sinks:
+            # egress runs LAST: every durable write for this tick has landed,
+            # so sink progress never commits ahead of its source shard, and
+            # subscriptions see corrections merged into the tick's deltas
+            egress_env = dict(env)
+            for gid, corr in corrections.items():
+                egress_env[gid] = (
+                    UpdateBatch.concat(egress_env[gid], corr)
+                    if gid in egress_env
+                    else corr
+                )
+            self._egress_tick(egress_env, ts, persist)
 
     def _mv_sink_correct(self, mv_gid: str, df, ts: int):
         """Self-correcting persist sink: append (desired − persisted) at `ts`.
@@ -1570,8 +1843,12 @@ class Coordinator:
         if window <= 0:
             return
         since = ts - window
-        for sub in getattr(self, "subscriptions", {}).values():
-            since = min(since, sub["frontier"] - 1)
+        for sub in self.subscriptions.values():
+            since = min(since, sub.frontier - 1)
+        for sink in self.sinks.values():
+            # sink read hold: commit-first re-derivation needs source shard
+            # history back to the last committed frame's frontier
+            since = min(since, sink.frontier - 1)
         if since <= 0:
             return
         for _gid, df, _src in self.dataflows:

@@ -195,6 +195,22 @@ PEEK_QUEUE_DEPTH = Config(
     "(tighter than coord_queue_depth so a read swarm can't starve writes); "
     "overflow sheds with 53300 (0 = off)",
 )
+SUBSCRIBE_QUEUE_DEPTH = Config(
+    "subscribe_queue_depth",
+    4096,
+    "updates a SUBSCRIBE's egress queue may buffer before the slow client "
+    "is shed with 53400 (SubscriptionOverflow) and the subscription torn "
+    "down — bounds how much history one stalled reader can pin (0 = off)",
+)
+SINK_COMMIT_ORDER = Config(
+    "sink_commit_order",
+    "emit-first",
+    "durable ordering of a FILE sink's per-tick (file append, progress CAS) "
+    "pair: emit-first appends the frame then commits progress (crash between "
+    "the two truncates the orphan tail on resume); commit-first commits then "
+    "appends (crash re-derives the missing frame from the source shard) — "
+    "both orderings are exactly-once, both are swept by the crash matrix",
+)
 SOURCE_INGEST_BUDGET = Config(
     "source_ingest_budget_bytes",
     8 << 20,
@@ -244,6 +260,8 @@ ALL_CONFIGS = [
     MAX_CONNECTIONS,
     COORD_QUEUE_DEPTH,
     PEEK_QUEUE_DEPTH,
+    SUBSCRIBE_QUEUE_DEPTH,
+    SINK_COMMIT_ORDER,
     SOURCE_INGEST_BUDGET,
     ENABLE_DELTA_JOIN,
     DELTA_JOIN_MAX_INPUTS,

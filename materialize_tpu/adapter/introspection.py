@@ -96,6 +96,25 @@ INTROSPECTION_TABLES = {
         ("capacity", ColType.INT64),
         ("records", ColType.INT64),
     ),
+    "mz_subscriptions": _desc(
+        ("id", ColType.STRING),
+        ("object_name", ColType.STRING),
+        ("state", ColType.STRING),
+        ("queue_depth", ColType.INT64),
+        ("delivered", ColType.INT64),
+        ("shed_count", ColType.INT64),
+        ("frontier", ColType.INT64),
+    ),
+    "mz_sinks": _desc(
+        ("id", ColType.STRING),
+        ("name", ColType.STRING),
+        ("from_name", ColType.STRING),
+        ("path", ColType.STRING),
+        ("format", ColType.STRING),
+        ("frontier", ColType.INT64),
+        ("emitted_updates", ColType.INT64),
+        ("emitted_bytes", ColType.INT64),
+    ),
     "mz_arrangement_sizes": _desc(
         ("dataflow", ColType.STRING),
         ("operator_id", ColType.INT64),
@@ -223,6 +242,25 @@ def introspection_rows(coord, name: str) -> list[tuple]:
         # since hold — the sharing win (and the compaction laggard) is
         # queryable without a profiler
         return coord.trace_manager.sharing_rows()
+    if name == "mz_subscriptions":
+        # the egress plane's live state (queue depth, delivery progress,
+        # shed accounting) — a stalled SUBSCRIBE client is diagnosable with
+        # one SELECT instead of a heap dump
+        return [
+            (
+                sid, sub.object_name, sub.state, sub.queue_depth(),
+                sub.delivered, sub.shed_count, sub.frontier,
+            )
+            for sid, sub in sorted(coord.subscriptions.items())
+        ]
+    if name == "mz_sinks":
+        return [
+            (
+                gid, snk.name, snk.from_name, snk.path, snk.format,
+                snk.frontier, snk.emitted_updates, snk.emitted_bytes,
+            )
+            for gid, snk in sorted(coord.sinks.items())
+        ]
     if name == "mz_arrangement_sizes":
         out = []
         for gid, df, _src in coord.dataflows:

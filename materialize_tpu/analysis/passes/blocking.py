@@ -43,6 +43,7 @@ ALLOW_BLOCKING = {
 
 SCOPE_DIRS = (
     "materialize_tpu/adapter/",
+    "materialize_tpu/egress/",
     "materialize_tpu/cluster/",
     "materialize_tpu/frontend/",
     "materialize_tpu/persist/",

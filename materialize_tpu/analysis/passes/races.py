@@ -52,6 +52,7 @@ ALLOW_LOCK_FREE = {
 
 SCOPE_DIRS = (
     "materialize_tpu/adapter/",
+    "materialize_tpu/egress/",
     "materialize_tpu/cluster/",
     "materialize_tpu/frontend/",
     "materialize_tpu/persist/",

@@ -1,0 +1,22 @@
+"""Egress plane: push-based SUBSCRIBE and exactly-once file sinks.
+
+The outbound half the serving stack was missing (reference:
+src/compute/src/sink/{subscribe,materialized_view}.rs). Two shapes:
+
+- `Subscription` (subscribe.py): a per-client bounded queue fed by the
+  coordinator at every commit tick with the collection's consolidated
+  update triples, drained by pgwire (COPY out stream) or the HTTP server
+  (chunked NDJSON / poll). Slow consumers are shed with the overload
+  taxonomy (errors.py: 53400 on queue overflow, 57014 on cancel, 57P05 on
+  idle), and teardown releases the subscription's compaction read hold.
+
+- `FileSink` (sink.py): a catalog object appending a view's per-tick
+  changelog to a file through the interchange text encoders, with a durable
+  progress register (persist shard) so a crash at ANY durable op resumes
+  exactly-once — no dropped or doubled deltas.
+"""
+
+from .sink import FileSink, progress_shard_id
+from .subscribe import Subscription
+
+__all__ = ["Subscription", "FileSink", "progress_shard_id"]

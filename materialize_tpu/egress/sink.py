@@ -1,0 +1,245 @@
+"""FILE sinks: a view's changelog appended to a file, exactly-once.
+
+The shape of the reference's storage-managed sinks (sink/materialized_view.rs
+writes a collection's deltas through persist; the Kafka sink pairs every
+emitted chunk with a durable progress record): each commit tick appends one
+*frame* — the tick's consolidated update triples in a canonical text
+encoding (interchange/text.py) — to the changelog file, and records a
+progress descriptor in a persist shard (`<gid>_progress`).
+
+The progress register holds ONE row describing the last committed frame:
+
+    (lower_offset, upper_offset, lower_ts, upper_ts)
+
+i.e. "the file is committed up to byte `upper_offset`, covering updates
+with time < `upper_ts`; the final frame spans bytes [lower_offset,
+upper_offset) and times [lower_ts, upper_ts)". Because the frame encoding
+is canonical (consolidated, sorted by (time, line)), any frame can be
+re-derived byte-identically from the source collection's shard.
+
+Exactly-once across a crash at ANY durable op, for both commit orderings
+(`sink_commit_order` dyncfg):
+
+- emit-first  (append frame, then CAS progress): a crash between the two
+  leaves an uncommitted tail — resume truncates the file to the durable
+  `upper_offset` and re-derives everything ≥ `upper_ts` from the shard.
+- commit-first (CAS progress, then append frame): a crash between the two
+  leaves a committed descriptor whose bytes never landed — resume truncates
+  to `lower_offset` and re-derives exactly [lower_ts, upper_ts).
+
+Torn file appends (a partial frame at the tail) fall out of the same two
+rules: the file is only ever trusted up to a durable offset, never by its
+raw length. Resume itself is idempotent — a crash during repair converges
+on the next boot (the crash-during-recovery half of the crash matrix).
+
+File appends are durable ops: they consult the installed CrashPlan
+(persist/crashpoints.py) under the label `file.append`, so the crash matrix
+sweeps them exactly like blob/CAS ops.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..interchange.text import ENCODERS
+from ..obs import log as obs_log
+from ..obs import metrics as obs_metrics
+
+_log = obs_log.get_logger("egress.sink")
+
+_FRAMES = obs_metrics.REGISTRY.counter(
+    "mzt_egress_sink_frames_total",
+    "changelog frames committed across all file sinks",
+)
+_BYTES = obs_metrics.REGISTRY.counter(
+    "mzt_egress_sink_bytes_total",
+    "changelog bytes committed across all file sinks",
+)
+
+
+def progress_shard_id(gid: str) -> str:
+    """The sink's progress register shard (persisted next to data shards)."""
+    return f"{gid}_progress"
+
+
+def consolidate_updates(updates: list) -> list:
+    """Host-side consolidation: sum diffs per (time, row), drop zeros."""
+    acc: dict = {}
+    for ts, diff, row in updates:
+        k = (int(ts), tuple(row))
+        acc[k] = acc.get(k, 0) + int(diff)
+    return [(ts, d, row) for (ts, row), d in acc.items() if d]
+
+
+class FileSink:
+    """One catalog sink: changelog file + durable progress register."""
+
+    def __init__(self, gid, name, from_name, from_gid, path, fmt, desc):
+        self.gid = gid
+        self.name = name
+        self.from_name = from_name
+        self.from_gid = from_gid
+        self.path = path
+        self.format = fmt
+        self.desc = desc
+        self.names = tuple(c.name for c in desc.columns)
+        self.encode = ENCODERS[fmt]
+        # mirrors of the durable register (authoritative copy is the shard)
+        self.offset = 0  # committed byte length of the changelog
+        self.frontier = 0  # updates with time < frontier are committed
+        self.emitted_updates = 0
+        self.emitted_bytes = 0
+
+    # -- canonical encoding ----------------------------------------------------
+    def canonical_frame(self, updates: list) -> bytes:
+        """Consolidated updates → deterministic bytes: one line per triple,
+        sorted by (time, line). Two emitters fed the same updates produce
+        the same bytes — the property crash re-derivation relies on."""
+        lines = [
+            (int(ts), self.encode(self.names, row, int(ts), int(diff)))
+            for ts, diff, row in updates
+        ]
+        lines.sort()
+        return "".join(line + "\n" for _ts, line in lines).encode()
+
+    # -- the durable protocol --------------------------------------------------
+    def emit(
+        self, updates: list, new_frontier: int, machine=None, epoch=None,
+        order: str = "emit-first",
+    ) -> int:
+        """Commit one frame covering [self.frontier, new_frontier).
+
+        `machine` is the progress register's ShardMachine (None = in-memory
+        sink on a non-durable coordinator). Returns the update count."""
+        updates = consolidate_updates(updates)
+        frame = self.canonical_frame(updates)
+        new_frontier = int(new_frontier)
+        if not frame:
+            if machine is None:
+                self.frontier = max(self.frontier, new_frontier)
+            return 0
+        new_offset = self.offset + len(frame)
+        if machine is None:
+            self._append(frame)
+        elif order == "commit-first":
+            self._commit_progress(machine, new_offset, new_frontier, epoch)
+            self._append(frame)
+        else:
+            self._append(frame)
+            self._commit_progress(machine, new_offset, new_frontier, epoch)
+        self.offset = new_offset
+        self.frontier = new_frontier
+        self.emitted_updates += len(updates)
+        self.emitted_bytes += len(frame)
+        _FRAMES.inc()
+        _BYTES.inc(len(frame))
+        return len(updates)
+
+    def resume(self, machine, derive, epoch=None, order: str = "emit-first") -> None:
+        """Boot-time exactly-once repair + catch-up.
+
+        `derive(lo_ts, hi_ts)` returns `(updates, upper)`: the source
+        shard's decoded updates with lo_ts ≤ time < hi_ts (hi_ts None =
+        everything, returning the shard's upper). Idempotent: every step
+        re-checks durable state, so a crash mid-repair converges."""
+        desc_row, _upper = self.read_register(machine)
+        lo_off, up_off, lo_ts, up_ts = desc_row or (0, 0, 0, 0)
+        length = self._file_length()
+        if length > up_off:
+            # uncommitted tail: an emit-first frame (or torn append) whose
+            # progress CAS never landed — discard; it re-derives below
+            self._truncate_to(up_off)
+        elif length < up_off:
+            # committed-but-unwritten frame (commit-first window): restore
+            # exactly [lo_ts, up_ts) — canonical encoding makes it the same
+            # bytes the crashed process would have written
+            self._truncate_to(lo_off)
+            updates, _ = derive(lo_ts, up_ts)
+            frame = self.canonical_frame(consolidate_updates(updates))
+            if lo_off + len(frame) != up_off:
+                _log.warn(
+                    "sink repair frame length mismatch; changelog may "
+                    "diverge from descriptor",
+                    sink=self.name, expected=up_off - lo_off, got=len(frame),
+                )
+            self._append(frame)
+        self.offset = up_off
+        self.frontier = up_ts
+        # catch-up: everything the source shard committed past the durable
+        # frontier (frames whose emission the crash preempted entirely)
+        updates, upper = derive(up_ts, None)
+        if updates:
+            self.emit(updates, upper, machine, epoch=epoch, order=order)
+
+    # -- progress register -----------------------------------------------------
+    def read_register(self, machine):
+        """(descriptor row | None, shard upper) — consolidated register."""
+        _seq, state = machine.fetch_state()
+        if state.upper <= 0:
+            return None, 0
+        acc: dict = {}
+        for cols in machine.snapshot(state.upper - 1):
+            for i in range(len(cols["times"])):
+                k = tuple(int(cols[f"c{j}"][i]) for j in range(4))
+                acc[k] = acc.get(k, 0) + int(cols["diffs"][i])
+        rows = [k for k, d in acc.items() if d]
+        return (rows[0] if rows else None), state.upper
+
+    def _commit_progress(self, machine, new_offset, new_frontier, epoch):
+        """Retract the stored descriptor, assert the new one, CAS the shard
+        upper to `new_frontier` — the frame's one durable commit point."""
+        desc_row, upper = self.read_register(machine)
+        t = new_frontier - 1
+        vals, diffs = [], []
+        if desc_row is not None:
+            vals.append(desc_row)
+            diffs.append(-1)
+        prev_off = desc_row[1] if desc_row is not None else 0
+        prev_ts = desc_row[3] if desc_row is not None else 0
+        vals.append((prev_off, new_offset, prev_ts, new_frontier))
+        diffs.append(1)
+        cols = {
+            f"c{j}": np.array([v[j] for v in vals], dtype=np.int64)
+            for j in range(4)
+        }
+        cols["times"] = np.full(len(vals), t, dtype=np.uint64)
+        cols["diffs"] = np.array(diffs, dtype=np.int64)
+        machine.compare_and_append(cols, upper, new_frontier, epoch=epoch)
+
+    # -- file plumbing ---------------------------------------------------------
+    def _append(self, data: bytes) -> None:
+        """Durable append: fsync'd, and a counted crash point (the matrix
+        sweeps `file.append` ops alongside blob.set/cas)."""
+        from ..persist import crashpoints
+
+        plan = crashpoints.installed_plan()
+        if plan is not None:
+            shape = plan.on_op("file.append", self.path)
+            if shape == "before":
+                plan.crash()
+            elif shape is not None:  # "after": bytes land, ack is lost
+                self._write(data)
+                plan.crash()
+        self._write(data)
+
+    def _write(self, data: bytes) -> None:
+        with open(self.path, "ab") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _truncate_to(self, offset: int) -> None:
+        if self._file_length() <= offset:
+            return
+        with open(self.path, "r+b") as f:
+            f.truncate(offset)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _file_length(self) -> int:
+        try:
+            return os.path.getsize(self.path)
+        except OSError:
+            return 0

@@ -12,9 +12,14 @@ ErrorResponse severity/code fields; adapter errors carry SqlState):
     53300  too_many_connections     — max_connections / admission-gate shed;
                                        RETRYABLE: the queue was full, not the
                                        statement wrong
-    53400  configuration_limit_exceeded — result would exceed max_result_size
+    53400  configuration_limit_exceeded — result would exceed max_result_size,
+                                       or a SUBSCRIBE client fell further than
+                                       subscribe_queue_depth ticks behind and
+                                       was shed
     57P05  idle_session_timeout     — idle_in_transaction_session_timeout
-                                       closed the connection
+                                       closed the connection (including a
+                                       SUBSCRIBE that delivered nothing and
+                                       whose client sent nothing)
 
 This module sits below every layer (frontend, adapter, dataflow) so the
 dataflow tick loop can abort with the canonical code without importing the
@@ -57,6 +62,16 @@ class TooManyConnections(SqlError):
 class ResultSizeExceeded(SqlError):
     """Result would exceed max_result_size; aborted before full
     materialization (53400)."""
+
+    sqlstate = "53400"
+
+
+class SubscriptionOverflow(SqlError):
+    """A SUBSCRIBE client consumed slower than the dataflow produced and its
+    bounded queue overflowed; the subscription is shed rather than letting
+    one slow reader pin unbounded history (53400 — the same "you exceeded a
+    configured resource bound" state as max_result_size, because the fix is
+    the same: raise the bound or consume faster)."""
 
     sqlstate = "53400"
 

@@ -7,6 +7,7 @@ The reference serves SQL over HTTP/WS next to pgwire
   POST /api/promote      finish a 0dt handoff (preflight → leader)
   POST /api/subscribe    {"query": "SELECT …"}        → {"subscription_id": …}
   GET  /api/subscribe/<id>/poll                       → {"updates": […], "frontier": N}
+  GET  /api/subscribe/<id>/stream                     → chunked NDJSON updates
   GET  /api/readyz                                    → "ok"
   GET  /metrics                                       → Prometheus text format
 
@@ -18,6 +19,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..adapter import Coordinator
@@ -36,6 +38,9 @@ def _json_default(v):
 class SqlHandler(BaseHTTPRequestHandler):
     coordinator: Coordinator = None
     lock: threading.Lock = None
+    # 1.1 so the SUBSCRIBE stream can use chunked transfer-encoding; every
+    # non-streaming reply carries content-length, so keep-alive stays sound
+    protocol_version = "HTTP/1.1"
 
     def log_message(self, fmt, *args):  # quiet by default
         pass
@@ -80,17 +85,104 @@ class SqlHandler(BaseHTTPRequestHandler):
 
             return self._reply(200, heap_profile_text(), "text/plain")
         if self.path.startswith("/api/subscribe/") and self.path.endswith("/poll"):
+            from ..errors import SqlError
+
             sub_id = self.path.split("/")[3]
             with self.lock:
                 try:
                     rows, frontier = self.coordinator.poll_subscription(sub_id)
                 except KeyError:
                     return self._reply(404, {"error": f"unknown subscription {sub_id}"})
+                except SqlError as e:  # shed (53400): report once, tear down
+                    self.coordinator.teardown_subscription(sub_id)
+                    return self._reply(
+                        400, {"error": str(e), "code": e.sqlstate}
+                    )
             updates = [
                 {"row": list(data), "timestamp": ts, "diff": d} for data, ts, d in rows
             ]
             return self._reply(200, {"updates": updates, "frontier": frontier})
+        if self.path.startswith("/api/subscribe/") and self.path.endswith("/stream"):
+            return self._stream_subscription(self.path.split("/")[3])
         return self._reply(404, {"error": "not found"})
+
+    def _stream_subscription(self, sub_id: str):
+        """Push SUBSCRIBE over HTTP: chunked NDJSON, one object per update
+        `{"mz_timestamp":…,"mz_progressed":…,"mz_diff":…,"row":[…]}`,
+        streamed until the collection is dropped, the client disconnects,
+        the bounded queue sheds the subscription (terminal line with
+        code 53400), or the idle timeout reaps it (terminal line with
+        code 57P05). The queue drain happens WITHOUT the coordinator lock."""
+        from ..errors import IdleTimeout, SqlError
+
+        with self.lock:
+            sub = self.coordinator.subscriptions.get(sub_id)
+            idle_ms = int(
+                self.coordinator.configs.get("idle_in_transaction_session_timeout")
+            )
+        if sub is None:
+            return self._reply(404, {"error": f"unknown subscription {sub_id}"})
+        self.send_response(200)
+        self.send_header("content-type", "application/x-ndjson")
+        self.send_header("transfer-encoding", "chunked")
+        self.end_headers()
+
+        def chunk(line: str) -> bool:
+            data = (line + "\n").encode()
+            try:
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+                return True
+            except OSError:
+                return False
+
+        last_delivery = time.monotonic()
+        try:
+            while True:
+                try:
+                    msg = sub.pop(timeout=0.25)
+                except SqlError as e:
+                    chunk(json.dumps({"error": str(e), "code": e.sqlstate}))
+                    break
+                if msg is None:
+                    if sub.state != "active":
+                        break  # dropped: end the stream cleanly
+                    if (
+                        idle_ms > 0
+                        and (time.monotonic() - last_delivery) > idle_ms / 1000.0
+                    ):
+                        self.coordinator.overload.bump("idle_timeouts")
+                        err = IdleTimeout(
+                            "terminating SUBSCRIBE stream due to "
+                            "idle-in-transaction session timeout"
+                        )
+                        chunk(json.dumps({"error": str(err), "code": err.sqlstate}))
+                        break
+                    continue
+                ts, progressed, diff, row = msg
+                last_delivery = time.monotonic()
+                ok = chunk(
+                    json.dumps(
+                        {
+                            "mz_timestamp": ts,
+                            "mz_progressed": progressed,
+                            "mz_diff": diff,
+                            "row": list(row) if row is not None else None,
+                        },
+                        default=_json_default,
+                    )
+                )
+                if not ok:
+                    break  # client went away: tear down below
+        finally:
+            with self.lock:
+                self.coordinator.teardown_subscription(sub_id)
+        try:
+            self.wfile.write(b"0\r\n\r\n")
+            self.wfile.flush()
+        except OSError:
+            pass
+        self.close_connection = True
 
     def do_POST(self):
         if self.path == "/api/sql":
@@ -180,6 +272,15 @@ def metrics_text(coord, lock) -> str:
         # concurrent _record_peek inserting a fresh bucket key mid-iteration
         # would fault the scrape
         peek_hist = sorted(dict(getattr(coord, "peek_histogram", {})).items())
+        sub_depth, sub_delivered, sink_frontier, sink_updates = [], [], [], []
+        for sid, sub in sorted(coord.subscriptions.items()):
+            labels = (("subscription", sid), ("object", sub.object_name))
+            sub_depth.append((labels, sub.queue_depth()))
+            sub_delivered.append((labels, sub.delivered))
+        for snk in coord.sinks.values():
+            labels = (("sink", snk.name), ("from", snk.from_name))
+            sink_frontier.append((labels, snk.frontier))
+            sink_updates.append((labels, snk.emitted_updates))
         ops, arr_recs, arr_bytes = [], [], []
         for gid, df, _src in coord.dataflows:
             for _obj, op_i, typ, el, _inv in df.operator_info():
@@ -238,6 +339,22 @@ def metrics_text(coord, lock) -> str:
             "mzt_arrangement_bytes", "gauge",
             "owner-charged bytes per arrangement (shared traces charged once)",
             arr_bytes,
+        ),
+        Snapshot(
+            "mzt_egress_subscription_queue_depth", "gauge",
+            "updates waiting in each subscription's bounded queue", sub_depth,
+        ),
+        Snapshot(
+            "mzt_egress_subscription_delivered", "counter",
+            "updates handed to each subscription's consumer", sub_delivered,
+        ),
+        Snapshot(
+            "mzt_egress_sink_progress_frontier", "gauge",
+            "durable progress frontier of each file sink", sink_frontier,
+        ),
+        Snapshot(
+            "mzt_egress_sink_emitted_updates", "counter",
+            "changelog updates committed by each file sink", sink_updates,
         ),
     ]
     # replica-process registry snapshots (mesh exchange, persist ops, …)

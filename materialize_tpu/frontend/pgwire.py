@@ -332,6 +332,8 @@ class PgConnection:
                 for row in r.rows:
                     self._send_data_row(row)
                 self.sock.sendall(_msg(b"C", _cstr(f"SELECT {len(r.rows)}")))
+            elif r.kind == "subscribe":
+                self._stream_subscription(r)
             elif r.kind == "copy":
                 # CopyOutResponse (text format), CopyData lines, CopyDone
                 ncols = len(r.columns)
@@ -345,6 +347,89 @@ class PgConnection:
                 self.sock.sendall(_msg(b"C", _cstr(r.status)))
             else:
                 self.sock.sendall(_msg(b"C", _cstr(r.status)))
+
+    # -- SUBSCRIBE streaming -----------------------------------------------------
+    def _stream_subscription(self, r: ExecResult) -> None:
+        """SUBSCRIBE over COPY out (the reference's pgwire SUBSCRIBE shape,
+        protocol.rs stream_rows): CopyOutResponse, then one CopyData text
+        row `(mz_timestamp, mz_progressed, mz_diff, cols…)` per update,
+        until the client cancels (57014), idles past
+        idle_in_transaction_session_timeout with nothing delivered (57P05),
+        falls behind the bounded queue (53400), sends any message (clean
+        CopyDone), disconnects, or the collection is dropped. The queue is
+        drained WITHOUT the coordinator lock — a slow client never stalls
+        the command loop; only teardown takes it."""
+        import select
+
+        from ..errors import QueryCanceled, SqlError
+
+        sub = r.subscription
+        ncols = 3 + len(sub.columns)
+        self.sock.sendall(
+            _msg(b"H", b"\x00" + struct.pack(">H", ncols) + b"\x00\x00" * ncols)
+        )
+        idle_ms = int(self.session.get("idle_in_transaction_session_timeout"))
+        last_activity = time.monotonic()
+        delivered = 0
+        try:
+            while True:
+                if self.session.cancelled.is_set():
+                    raise QueryCanceled("canceling statement due to user request")
+                # client traffic ends the stream: CopyDone/CopyFail/anything
+                # means "stop subscribing" (run() processes the pending
+                # message after CommandComplete); EOF means the client is gone
+                ready, _w, _x = select.select([self.sock], [], [], 0)
+                if ready:
+                    try:
+                        peeked = self.sock.recv(1, socket.MSG_PEEK)
+                    except OSError:
+                        peeked = b""
+                    if peeked == b"":
+                        self._teardown_sub(sub, "cancelled")
+                        return  # connection dropped; run() sees EOF next read
+                    break
+                msg = sub.pop(timeout=0.05)
+                if msg is not None:
+                    ts, progressed, diff, row = msg
+                    self._send_copy_row(ts, progressed, diff, row, sub.columns)
+                    delivered += 1
+                    last_activity = time.monotonic()
+                    continue
+                if sub.state != "active":
+                    break  # dropped: the stream ends cleanly
+                if idle_ms > 0 and (time.monotonic() - last_activity) > idle_ms / 1000.0:
+                    self.coord.overload.bump("idle_timeouts")
+                    raise IdleTimeout(
+                        "terminating SUBSCRIBE due to idle-in-transaction "
+                        "session timeout"
+                    )
+        except SqlError as e:
+            # 57014 / 57P05 / 53400: teardown releases the read hold and the
+            # hidden MV's trace holds; the error ends the COPY per protocol
+            self._teardown_sub(sub, "cancelled")
+            self._send_error(e.sqlstate, str(e))
+            return
+        self._teardown_sub(sub, "cancelled")
+        self.sock.sendall(_msg(b"c", b""))
+        self.sock.sendall(_msg(b"C", _cstr(f"SUBSCRIBE {delivered}")))
+
+    def _teardown_sub(self, sub, state: str) -> None:
+        with self.lock:
+            self.coord.teardown_subscription(sub.sub_id, state=state)
+
+    def _send_copy_row(self, ts, progressed, diff, row, columns) -> None:
+        vals = [str(ts), "t" if progressed else "f", str(diff)]
+        if row is None:  # progress rows carry no data columns
+            vals += ["\\N"] * len(columns)
+        else:
+            for v in row:
+                if v is None:
+                    vals.append("\\N")
+                elif isinstance(v, bool):
+                    vals.append("t" if v else "f")
+                else:
+                    vals.append(str(v))
+        self.sock.sendall(_msg(b"d", ("\t".join(vals) + "\n").encode()))
 
     # -- extended query protocol ------------------------------------------------
     def _ext_error(self, code: str, message: str) -> None:

@@ -6,6 +6,7 @@ the file source (text formats); this package holds the binary codecs:
 
 - `avro`: schema-driven Avro binary + object container files (OCF)
 - `protobuf`: wire-format decoding against a lightweight field descriptor
+- `text`: canonical JSON/CSV line ENCODERS for the egress plane (file sinks)
 """
 
-from . import avro, protobuf  # noqa: F401
+from . import avro, protobuf, text  # noqa: F401

@@ -1,0 +1,46 @@
+"""Text-format row encoders (JSON lines / CSV) for the egress plane.
+
+The encode half of the reference's mz-interchange text codecs
+(src/interchange/src/{json,csv}.rs encode paths): the file-source decoders
+(storage/file_source.py) read these formats in; sinks write them out. Every
+encoder is a pure function row → one line WITHOUT the trailing newline, and
+the encodings are canonical (JSON with sorted=False but fixed key order,
+CSV via csv.writer defaults) so two emitters given identical update streams
+produce byte-identical files — the property the sink crash matrix asserts.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+
+
+def encode_json_line(names: tuple, row: tuple, ts: int, diff: int) -> str:
+    """One changelog update as a JSON object line: row columns by name plus
+    the mz_timestamp/mz_diff envelope (the reference's JSON debezium-ish
+    envelope, flattened)."""
+    doc = dict(zip(names, (_jsonable(v) for v in row)))
+    doc["mz_timestamp"] = ts
+    doc["mz_diff"] = diff
+    return json.dumps(doc, separators=(",", ":"), default=str)
+
+
+def encode_csv_line(names: tuple, row: tuple, ts: int, diff: int) -> str:
+    """One changelog update as a CSV record: ts, diff, then the columns (the
+    envelope leads so the line is self-describing without a header)."""
+    buf = io.StringIO()
+    w = csv.writer(buf, lineterminator="")
+    w.writerow([ts, diff] + ["" if v is None else v for v in row])
+    return buf.getvalue()
+
+
+def _jsonable(v):
+    # numpy scalars leak out of host decode on some paths; normalize so the
+    # canonical encoding never depends on the producing array's dtype
+    if hasattr(v, "item"):
+        return v.item()
+    return v
+
+
+ENCODERS = {"json": encode_json_line, "csv": encode_csv_line}

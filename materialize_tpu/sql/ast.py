@@ -363,4 +363,27 @@ class Copy:
 
 @dataclass(frozen=True)
 class Subscribe:
+    """SUBSCRIBE [TO] (query | name) [WITH (SNAPSHOT [true|false], PROGRESS)].
+
+    `snapshot` controls whether the collection's contents as of the read
+    timestamp are emitted before the per-tick deltas; `progress` requests
+    interleaved progress rows (mz_progressed = true) marking frontier
+    advancement (the reference's SUBSCRIBE options, sql/src/plan/statement/
+    dml.rs SubscribeStatement)."""
+
     query: Query
+    snapshot: bool = True
+    progress: bool = False
+
+
+@dataclass(frozen=True)
+class CreateSink:
+    """CREATE SINK <name> FROM <view> INTO FILE '<path>' FORMAT {JSON|CSV}:
+    a catalog object streaming the view's consolidated per-tick changelog
+    into an append-only file with exactly-once resume (the
+    sink/materialized_view.rs shape, aimed at a file instead of Kafka)."""
+
+    name: str
+    from_name: str
+    path: str
+    format: str  # json | csv

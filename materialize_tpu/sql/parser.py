@@ -146,7 +146,22 @@ class Parser:
                         from_=(ast.TableRef(name),),
                     )
                 )
-            return ast.Subscribe(q)
+            snapshot, progress = True, False
+            if self.eat_kw("with"):
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    opt = self.ident().lower()
+                    if opt == "snapshot":
+                        snapshot = True
+                        if self.at_kw("true") or self.at_kw("false"):
+                            snapshot = self.next().value == "true"
+                    elif opt == "progress":
+                        progress = True
+                    else:
+                        raise ParseError(f"unknown SUBSCRIBE option {opt!r}")
+                    self.eat_op(",")
+                self.expect_op(")")
+            return ast.Subscribe(q, snapshot=snapshot, progress=progress)
         raise ParseError(f"unsupported statement start: {self.peek().value!r}")
 
     # -- DDL ------------------------------------------------------------------
@@ -211,6 +226,24 @@ class Parser:
                     self.eat_op(",")
                 self.expect_op(")")
             return ast.CreateSource(name, gen, tuple(options))
+        if self.eat_kw("sink"):
+            name = self.ident()
+            self.expect_kw("from")
+            from_name = self.ident()
+            self.expect_kw("into")
+            if self.ident().lower() != "file":
+                raise ParseError("only CREATE SINK … INTO FILE is supported")
+            t = self.peek()
+            if t.kind != "STRING":
+                raise ParseError(f"expected file path string, found {t.value!r}")
+            path = self.next().value
+            fmt = "json"
+            if self.peek().kind == "IDENT" and self.peek().value == "format":
+                self.next()
+                fmt = self.ident().lower()
+            if fmt not in ("json", "csv"):
+                raise ParseError(f"unsupported sink format {fmt!r}")
+            return ast.CreateSink(name, from_name, path, fmt)
         if self.eat_kw("materialized"):
             self.expect_kw("view")
             name = self.ident()
