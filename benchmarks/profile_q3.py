@@ -73,7 +73,36 @@ def main():
     jax.profiler.stop_trace()
     _phase(f"traced {ticks} ticks in {elapsed:.3f}s ({elapsed/ticks*1000:.0f} ms/tick)")
 
+    kernel_report(int(state.accum.levels[-1].hashes.shape[-1]))
     report()
+
+
+def kernel_report(cap: int, iters: int = 20):
+    """Isolated per-kernel wall times at the run's arrangement capacity, for
+    both registered backends — untraced perf_counter around warmed jitted
+    calls, so the numbers attribute the tick's probe/gather/consolidate terms
+    without trusting trace-event self-time accounting."""
+    import jax
+    import numpy as np
+
+    from benchmarks.bench_kernels import _cases, _timed
+    from materialize_tpu.ops import kernels
+
+    interp = kernels.pallas_interpret()
+    print(f"# registered kernels at cap={cap} (pallas_interpret={interp}):")
+    cases = _cases(cap)
+    for name, ins in cases.items():
+        row = [f"{name:10s}"]
+        for backend in ("xla", "pallas"):
+
+            def call(*a, _n=name, _b=backend):
+                with kernels.using_backend(_b):
+                    return kernels.dispatch(_n, *a)
+
+            sec = _timed(jax.jit(call), ins, iters)
+            label = backend + ("~interp" if backend == "pallas" and interp else "")
+            row.append(f"{label}={sec * 1e6:9.1f}us")
+        print("  " + "  ".join(row))
 
 
 def report():

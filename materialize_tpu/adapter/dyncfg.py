@@ -250,6 +250,17 @@ JAX_PROFILER_DIR = Config(
     "trace collection)",
 )
 
+# -- kernel backend (ops/kernels/: Pallas vs XLA hot-path kernels) -----------
+KERNEL_BACKEND = Config(
+    "kernel_backend",
+    "auto",
+    "which implementation the registered hot-path kernels (run_sum, "
+    "multi_take, probe, probe2; ops/kernels/) dispatch to: 'auto' picks "
+    "pallas on TPU and xla elsewhere, 'xla'/'pallas' force a backend on any "
+    "platform (pallas off-TPU runs in interpret mode — correct but slow, for "
+    "differential testing); takes effect at the next tick render, no restart",
+)
+
 ALL_CONFIGS = [
     MV_SINK_SELF_CORRECT,
     CTP_MAX_FRAME_BYTES,
@@ -278,6 +289,7 @@ ALL_CONFIGS = [
     INTROSPECTION_INTERVAL,
     ENABLE_JAX_PROFILER,
     JAX_PROFILER_DIR,
+    KERNEL_BACKEND,
 ]
 
 

@@ -4,6 +4,7 @@ from .blocking import BlockingUnderLock
 from .crashsafety import CrashSwallow, DurableCleanup
 from .dtype64 import Dtype64
 from .hygiene import ListenerHygiene
+from .kernels_rule import KernelDispatchCoherence
 from .metrics_rule import MetricsCoherence
 from .races import LockDiscipline
 from .registry_rules import CtpCoherence, DyncfgCoherence, SqlstateCoherence
@@ -22,6 +23,7 @@ ALL_RULES = [
     SqlstateCoherence(),
     CtpCoherence(),
     ListenerHygiene(),
+    KernelDispatchCoherence(),
     MetricsCoherence(),
 ]
 

@@ -49,6 +49,7 @@ SCOPE_DIRS = (
     "materialize_tpu/persist/",
     "materialize_tpu/storage/",
     "materialize_tpu/obs/",
+    "materialize_tpu/ops/kernels/",
 )
 
 

@@ -30,6 +30,7 @@ REQUIRED_FAMILIES = (
     "mzt_mesh_exchange_bytes_total",
     "mzt_heartbeat_rtt_seconds",
     "mzt_dataflow_tick_duration_ns",
+    "mzt_kernel_dispatch_total",
 )
 
 _BUMP = re.compile(r'(?:\.bump|\.record_max)\(\s*"([a-z_]+)"')

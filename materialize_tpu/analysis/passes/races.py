@@ -59,6 +59,7 @@ SCOPE_DIRS = (
     "materialize_tpu/storage/",
     "materialize_tpu/obs/",
     "materialize_tpu/orchestrator/",
+    "materialize_tpu/ops/kernels/",
 )
 
 

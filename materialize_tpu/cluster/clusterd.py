@@ -179,6 +179,14 @@ class ClusterState:
                 bool(cfg.get("enable_jax_profiler", False)),
                 str(cfg.get("jax_profiler_dir", "")),
             )
+            # kernel backend likewise: the hot-path dispatches happen in this
+            # process's tick renders, so the mode must land here
+            from ..ops import kernels
+
+            try:
+                kernels.set_kernel_backend(str(cfg.get("kernel_backend", "auto")))
+            except ValueError:
+                pass  # unknown value in an old snapshot: keep the default
             return p.Frontiers({})
         if isinstance(cmd, p.FetchStats):
             return self._fetch_stats()

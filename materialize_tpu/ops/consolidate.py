@@ -22,6 +22,8 @@ import jax.numpy as jnp
 
 from ..repr.batch import PAD_TIME, UpdateBatch
 from ..repr.hashing import PAD_HASH
+from . import kernels
+from .kernels import batch_permute
 from .search import searchsorted2, sort_perm
 
 
@@ -117,13 +119,11 @@ def _consolidate_sorted(b: UpdateBatch, compact: bool) -> UpdateBatch:
     """Run-merge + mask tail shared by `consolidate` and `merge_consolidate`.
 
     Requires `b` ordered so equal (key, row, time) rows are adjacent."""
-    cap = b.cap
     cmp_cols = [b.hashes, *b.keys, *b.vals, b.times]
     same = row_equal_prev(cmp_cols)
     run_start = ~same
-    seg = jnp.cumsum(run_start.astype(jnp.int32)) - 1
-    sums = jax.ops.segment_sum(b.diffs, seg, num_segments=cap)
-    diff_out = jnp.where(run_start, sums[seg], 0)
+    # segmented-sum-by-run kernel: run totals at run starts, 0 elsewhere
+    (diff_out,) = kernels.dispatch("run_sum", run_start, (b.diffs,))
 
     live = run_start & (diff_out != 0) & (b.hashes != PAD_HASH)
     diffs = jnp.where(live, diff_out, 0)
@@ -136,10 +136,17 @@ def _consolidate_sorted(b: UpdateBatch, compact: bool) -> UpdateBatch:
     times = jnp.where(live, b.times, PAD_TIME)
 
     perm = _stable_partition_perm(live)
-    return UpdateBatch(hashes, keys, vals, times, diffs).permute(perm)
+    return batch_permute(UpdateBatch(hashes, keys, vals, times, diffs), perm)
 
 
-@partial(jax.jit, static_argnames=("compact",))
+@partial(jax.jit, static_argnames=("compact", "backend"))
+def _consolidate(batch: UpdateBatch, compact: bool, backend: str) -> UpdateBatch:
+    with kernels.using_backend(backend):
+        k_hi, k_lo = pack_sort_key(batch)
+        order = sort_perm((batch.times, k_lo, k_hi))
+        return _consolidate_sorted(batch_permute(batch, order), compact)
+
+
 def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
     """Canonicalize a batch: hash-sorted, equal rows merged, no zero diffs.
 
@@ -167,12 +174,32 @@ def consolidate(batch: UpdateBatch, compact: bool = True) -> UpdateBatch:
     everywhere (consumers test diff != 0) but DO widen join candidate ranges,
     so arrangements should stay compacted.
     """
-    k_hi, k_lo = pack_sort_key(batch)
-    order = sort_perm((batch.times, k_lo, k_hi))
-    return _consolidate_sorted(batch.permute(order), compact)
+    return _consolidate(batch, compact, kernels.active_backend())
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("backend",))
+def _merge_consolidate(
+    a: UpdateBatch, b: UpdateBatch, since, backend: str
+) -> UpdateBatch:
+    with kernels.using_backend(backend):
+        ka_hi, ka_lo = pack_sort_key(a)
+        kb_hi, kb_lo = pack_sort_key(b)
+        na, nb = a.cap, b.cap
+        pa = jnp.arange(na, dtype=jnp.int32) + searchsorted2(
+            kb_hi, kb_lo, ka_hi, ka_lo, side="left"
+        )
+        pb = jnp.arange(nb, dtype=jnp.int32) + searchsorted2(
+            ka_hi, ka_lo, kb_hi, kb_lo, side="right"
+        )
+        pos = jnp.concatenate([pa, pb])
+        iota = jnp.arange(na + nb, dtype=jnp.int32)
+        perm = (pos * 0).at[pos].set(iota)
+        cat = batch_permute(UpdateBatch.concat(a, b), perm)
+        if since is not None:
+            cat = advance_times(cat, since)
+        return _consolidate_sorted(cat, compact=True)
+
+
 def merge_consolidate(
     a: UpdateBatch, b: UpdateBatch, since: jnp.ndarray | None = None
 ) -> UpdateBatch:
@@ -193,22 +220,7 @@ def merge_consolidate(
     still cancel once `since` passes both (times then collapse equal), so
     this costs capacity transiently, never correctness (multiset semantics).
     """
-    ka_hi, ka_lo = pack_sort_key(a)
-    kb_hi, kb_lo = pack_sort_key(b)
-    na, nb = a.cap, b.cap
-    pa = jnp.arange(na, dtype=jnp.int32) + searchsorted2(
-        kb_hi, kb_lo, ka_hi, ka_lo, side="left"
-    )
-    pb = jnp.arange(nb, dtype=jnp.int32) + searchsorted2(
-        ka_hi, ka_lo, kb_hi, kb_lo, side="right"
-    )
-    pos = jnp.concatenate([pa, pb])
-    iota = jnp.arange(na + nb, dtype=jnp.int32)
-    perm = (pos * 0).at[pos].set(iota)
-    cat = UpdateBatch.concat(a, b).permute(perm)
-    if since is not None:
-        cat = advance_times(cat, since)
-    return _consolidate_sorted(cat, compact=True)
+    return _merge_consolidate(a, b, since, kernels.active_backend())
 
 
 def _cmp_view(c: jnp.ndarray) -> jnp.ndarray:

@@ -31,7 +31,6 @@ from .reduce import (
 )
 
 
-@partial(jax.jit, static_argnames=("mfp", "key_cols", "aggs"))
 def fused_mfp_reduce_step(
     state: AccumState,
     delta: UpdateBatch,
@@ -41,6 +40,37 @@ def fused_mfp_reduce_step(
     aggs: tuple,
 ):
     """(state, Δin, t) → (state', Δout, Δerrs) in one XLA program."""
+    from . import kernels
+
+    return _fused_mfp_reduce_step(
+        state, delta, time, mfp, key_cols, aggs, kernels.active_backend()
+    )
+
+
+@partial(jax.jit, static_argnames=("mfp", "key_cols", "aggs", "backend"))
+def _fused_mfp_reduce_step(
+    state: AccumState,
+    delta: UpdateBatch,
+    time,
+    mfp: MapFilterProject,
+    key_cols: tuple[int, ...],
+    aggs: tuple,
+    backend: str,
+):
+    from . import kernels
+
+    with kernels.using_backend(backend):
+        return _fused_mfp_reduce_step_body(state, delta, time, mfp, key_cols, aggs)
+
+
+def _fused_mfp_reduce_step_body(
+    state: AccumState,
+    delta: UpdateBatch,
+    time,
+    mfp: MapFilterProject,
+    key_cols: tuple[int, ...],
+    aggs: tuple,
+):
     if mfp.is_identity():
         oks, errs1 = delta, None
     else:

@@ -28,26 +28,41 @@ import jax.numpy as jnp
 
 from ..repr.batch import PAD_TIME, UpdateBatch, bucket_cap
 from ..repr.hashing import PAD_HASH
+from . import kernels
 from .search import searchsorted
 
 
-@jax.jit
 def _probe_ranges(probe: UpdateBatch, arr: UpdateBatch):
     # branchless fixed-depth binary search (ops/search.py): no while loop,
-    # i32 positions — the probe kernel is pure gather/compare/select
+    # i32 positions — the probe kernel is pure gather/compare/select.
+    # NOT jitted: the search dispatches to the active kernel backend, so the
+    # jit cache key must carry the backend — callers (join_total /
+    # join_materialize / the fused tick) own the boundary.
     lo = searchsorted(arr.hashes, probe.hashes, side="left")
     hi = searchsorted(arr.hashes, probe.hashes, side="right")
     counts = jnp.where(probe.live, hi - lo, 0)
     return lo, counts
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("backend",))
+def _join_total(probe: UpdateBatch, arr: UpdateBatch, backend: str) -> jnp.ndarray:
+    with kernels.using_backend(backend):
+        _, counts = _probe_ranges(probe, arr)
+        return jnp.sum(counts)
+
+
 def join_total(probe: UpdateBatch, arr: UpdateBatch) -> jnp.ndarray:
-    _, counts = _probe_ranges(probe, arr)
-    return jnp.sum(counts)
+    return _join_total(probe, arr, kernels.active_backend())
 
 
-@partial(jax.jit, static_argnames=("out_cap", "swap"))
+@partial(jax.jit, static_argnames=("out_cap", "swap", "backend"))
+def _join_materialize(
+    probe: UpdateBatch, arr: UpdateBatch, out_cap: int, swap: bool, backend: str
+) -> UpdateBatch:
+    with kernels.using_backend(backend):
+        return _join_materialize_body(probe, arr, out_cap, swap)
+
+
 def join_materialize(
     probe: UpdateBatch, arr: UpdateBatch, out_cap: int, swap: bool = False
 ) -> UpdateBatch:
@@ -58,6 +73,12 @@ def join_materialize(
     regardless of which side streamed). Requires out_cap >= total matches
     (host checks via `join_total`).
     """
+    return _join_materialize(probe, arr, out_cap, swap, kernels.active_backend())
+
+
+def _join_materialize_body(
+    probe: UpdateBatch, arr: UpdateBatch, out_cap: int, swap: bool = False
+) -> UpdateBatch:
     lo, counts = _probe_ranges(probe, arr)
     cum = jnp.cumsum(counts)  # inclusive, i32 (counts bounded by capacities)
     total = cum[-1] if counts.shape[0] > 0 else jnp.zeros((), dtype=jnp.int32)
@@ -71,23 +92,33 @@ def join_materialize(
     ai = jnp.clip(ai, 0, arr.cap - 1)
     valid = j < total
 
+    # fused multi-column gather: one dtype-grouped pass per side instead of
+    # one XLA gather per key/val/time/diff column
+    nkp = len(probe.keys)
+    p_g = kernels.multi_take(
+        (*probe.keys, *probe.vals, probe.hashes, probe.times, probe.diffs), pi
+    )
+    a_g = kernels.multi_take(
+        (*arr.keys, *arr.vals, arr.times, arr.diffs), ai
+    )
+
     # true key equality (collision guard); canonical views so float NULL
-    # sentinels (NaN) compare equal and -0.0 == 0.0
+    # sentinels (NaN) compare equal and -0.0 == 0.0 (value_view is
+    # elementwise, so it commutes with the gather)
     from ..repr.hashing import value_view
 
     eq = jnp.ones((out_cap,), dtype=jnp.bool_)
-    for pk, ak in zip(probe.keys, arr.keys):
-        pv, av = value_view(pk), value_view(ak)
-        eq = eq & (pv[pi] == av[ai])
+    for pk, ak in zip(p_g[:nkp], a_g[: len(arr.keys)]):
+        eq = eq & (value_view(pk) == value_view(ak))
 
-    diffs = jnp.where(valid & eq, probe.diffs[pi] * arr.diffs[ai], 0)
-    times = jnp.maximum(probe.times[pi], arr.times[ai])
+    diffs = jnp.where(valid & eq, p_g[-1] * a_g[-1], 0)
+    times = jnp.maximum(p_g[-2], a_g[-2])
     ok = valid & eq & (diffs != 0)
-    left = tuple(v[pi] for v in probe.vals)
-    right = tuple(v[ai] for v in arr.vals)
+    left = tuple(p_g[nkp : nkp + len(probe.vals)])
+    right = tuple(a_g[len(arr.keys) : len(arr.keys) + len(arr.vals)])
     vals = (right + left) if swap else (left + right)
     return UpdateBatch(
-        hashes=jnp.where(ok, probe.hashes[pi], PAD_HASH),
+        hashes=jnp.where(ok, p_g[-3], PAD_HASH),
         keys=(),
         vals=vals,
         times=jnp.where(ok, times, PAD_TIME),

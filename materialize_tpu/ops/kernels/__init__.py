@@ -1,0 +1,39 @@
+"""Pluggable device-kernel layer for the tick hot path (doc/KERNELS.md).
+
+Three kernels, each registered with its XLA lowering (the reference oracle)
+AND a hand-written Pallas program, selected by the `kernel_backend` dyncfg:
+
+- ``run_sum``   — segmented-sum-by-run over a canonically ordered batch
+                  (segsum.py; backs consolidate / merge_consolidate /
+                  consolidate_accums)
+- ``multi_take``— fused multi-column permute-gather, dtype-grouped
+                  (permute.py; backs every payload permute and the join /
+                  topk two-pass gathers)
+- ``probe``/``probe2`` — batched fixed-depth binary search, keys
+                  VMEM-resident (probe.py; backs ops/search.py)
+
+The contract is bit-identity: a Pallas backend must produce byte-identical
+output to its XLA reference on every input. See registry.py for backend
+resolution and the jit-boundary discipline.
+"""
+
+from __future__ import annotations
+
+from .registry import (  # noqa: F401
+    KERNEL_BACKENDS,
+    KERNEL_MODES,
+    active_backend,
+    dispatch,
+    dispatch_counts,
+    kernel_backend_mode,
+    pallas_interpret,
+    register_kernel,
+    registered_kernels,
+    resolve_backend,
+    set_kernel_backend,
+    using_backend,
+)
+
+# importing the kernel modules registers their backends
+from . import permute, probe, segsum  # noqa: E402,F401
+from .permute import batch_permute, multi_take  # noqa: F401

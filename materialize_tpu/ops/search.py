@@ -10,10 +10,12 @@ steps with no control flow at all — the accelerator-native scan formulation
 (cf. arXiv:2505.15112) and the gather-structured probe shape of
 hash-partitioned join hardware (cf. arXiv:1905.13376).
 
-Invariant maintained per step: the insertion point lies in [pos, pos + cur];
-each step compares one gathered element and halves `cur`. All positions are
-i32 (capacities are far below 2^31), so probe kernels carry no 64-bit index
-arithmetic.
+Since PR 15 both searches are registry kernels (`probe` / `probe2`,
+ops/kernels/probe.py): the unrolled XLA lowering stays as the reference
+oracle, and the Pallas backend runs the identical loop with the sorted keys
+VMEM-resident. Dispatch resolves at trace time, so jitted callers must carry
+the active backend in their cache key (ops entry points thread a static
+``backend`` argument; see ops/kernels/registry.py).
 
 `sort_perm` is the 32-bit `jnp.lexsort`: under x64, jnp's argsort/lexsort
 carry an i64 iota operand through the sort — a 64-bit operand the TPU splits
@@ -26,17 +28,7 @@ from __future__ import annotations
 import jax.lax as lax
 import jax.numpy as jnp
 
-
-def _pred(a_elem: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
-    return (a_elem < q) if side == "left" else (a_elem <= q)
-
-
-def _pred2(a_hi, a_lo, q_hi, q_lo, side: str) -> jnp.ndarray:
-    """(hi, lo) pair comparison: a < q (left) / a <= q (right) on the packed
-    64-bit order, evaluated entirely in 32-bit lanes."""
-    if side == "left":
-        return (a_hi < q_hi) | ((a_hi == q_hi) & (a_lo < q_lo))
-    return (a_hi < q_hi) | ((a_hi == q_hi) & (a_lo <= q_lo))
+from .kernels import dispatch
 
 
 def searchsorted(a: jnp.ndarray, q: jnp.ndarray, side: str = "left") -> jnp.ndarray:
@@ -44,17 +36,9 @@ def searchsorted(a: jnp.ndarray, q: jnp.ndarray, side: str = "left") -> jnp.ndar
 
     Returns i32 insertion points in [0, n]. ceil(log2(n)) + 1 unrolled
     steps; no data-dependent control flow (vectorizes on XLA:CPU and the
-    TPU VPU alike).
+    TPU VPU alike). Dispatches to the active kernel backend.
     """
-    n = int(a.shape[0])
-    pos = jnp.zeros(q.shape, dtype=jnp.int32)
-    cur = n
-    while cur > 1:
-        half = cur >> 1
-        mid = pos + (half - 1)  # compare a[pos + half - 1]
-        pos = jnp.where(_pred(a[mid], q, side), pos + half, pos)
-        cur -= half
-    return pos + _pred(a[pos], q, side).astype(jnp.int32)
+    return dispatch("probe", a, q, side=side)
 
 
 def searchsorted2(
@@ -68,17 +52,9 @@ def searchsorted2(
 
     The 32-bit replacement for searching a packed u64 key `(hi << 32) | lo`
     — same order, two u32 gathers per step instead of one split u64.
+    Dispatches to the active kernel backend.
     """
-    n = int(a_hi.shape[0])
-    pos = jnp.zeros(q_hi.shape, dtype=jnp.int32)
-    cur = n
-    while cur > 1:
-        half = cur >> 1
-        mid = pos + (half - 1)
-        go = _pred2(a_hi[mid], a_lo[mid], q_hi, q_lo, side)
-        pos = jnp.where(go, pos + half, pos)
-        cur -= half
-    return pos + _pred2(a_hi[pos], a_lo[pos], q_hi, q_lo, side).astype(jnp.int32)
+    return dispatch("probe2", a_hi, a_lo, q_hi, q_lo, side=side)
 
 
 def sort_perm(cols) -> jnp.ndarray:

@@ -31,7 +31,9 @@ from ..repr.batch import (
     to_device_time,
 )
 from ..repr.hashing import PAD_HASH
+from . import kernels
 from .consolidate import advance_times, consolidate, row_equal_prev
+from .kernels import batch_permute
 from .search import searchsorted, sort_perm
 
 
@@ -54,18 +56,26 @@ class TopKPlan:
     nulls_last: tuple[bool, ...] | None = None
 
 
-@jax.jit
 def distinct_keys(delta_keyed: UpdateBatch) -> UpdateBatch:
     """Distinct (hash, key) probes of a keyed batch: one live row per key.
 
     Diffs are replaced by 1 (presence marker); vals dropped.
     """
+    return _distinct_keys(delta_keyed, kernels.active_backend())
+
+
+@partial(jax.jit, static_argnames=("backend",))
+def _distinct_keys(delta_keyed: UpdateBatch, backend: str) -> UpdateBatch:
+    with kernels.using_backend(backend):
+        return _distinct_keys_body(delta_keyed)
+
+
+def _distinct_keys_body(delta_keyed: UpdateBatch) -> UpdateBatch:
     b = delta_keyed
     cols = [*(k for k in reversed(b.keys)), b.hashes]
     order = sort_perm(cols)
-    h = b.hashes[order]
-    ks = tuple(k[order] for k in b.keys)
-    live_in = b.live[order]
+    g = kernels.multi_take((b.hashes, *b.keys, b.live), order)
+    h, ks, live_in = g[0], tuple(g[1:-1]), g[-1]
     same = row_equal_prev((h, *ks))
     # first live row of each (hash,key) run survives; a run may mix live and
     # dead rows, so mark a row live if it's the first live one in its run
@@ -81,25 +91,46 @@ def distinct_keys(delta_keyed: UpdateBatch) -> UpdateBatch:
     hashes = jnp.where(first_live, h, PAD_HASH)
     keys = tuple(jnp.where(first_live, k, jnp.zeros_like(k)) for k in ks)
     perm = sort_perm((~first_live,))
-    return UpdateBatch(
-        hashes[perm],
-        tuple(k[perm] for k in keys),
-        (),
-        jnp.where(first_live, 0, PAD_TIME)[perm].astype(TIME_DTYPE),
-        jnp.where(first_live, 1, 0)[perm].astype(DIFF_DTYPE),
+    g = kernels.multi_take(
+        (
+            hashes,
+            *keys,
+            jnp.where(first_live, 0, PAD_TIME).astype(TIME_DTYPE),
+            jnp.where(first_live, 1, 0).astype(DIFF_DTYPE),
+        ),
+        perm,
     )
+    return UpdateBatch(g[0], tuple(g[1:-2]), (), g[-2], g[-1])
 
 
-@jax.jit
 def _gather_total(probes: UpdateBatch, arr: UpdateBatch) -> jnp.ndarray:
-    lo = searchsorted(arr.hashes, probes.hashes, side="left")
-    hi = searchsorted(arr.hashes, probes.hashes, side="right")
-    return jnp.sum(jnp.where(probes.live, hi - lo, 0))
+    return _gather_total_jit(probes, arr, kernels.active_backend())
 
 
-@partial(jax.jit, static_argnames=("out_cap",))
+@partial(jax.jit, static_argnames=("backend",))
+def _gather_total_jit(probes: UpdateBatch, arr: UpdateBatch, backend: str):
+    with kernels.using_backend(backend):
+        lo = searchsorted(arr.hashes, probes.hashes, side="left")
+        hi = searchsorted(arr.hashes, probes.hashes, side="right")
+        return jnp.sum(jnp.where(probes.live, hi - lo, 0))
+
+
 def _gather_materialize(probes: UpdateBatch, arr: UpdateBatch, out_cap: int) -> UpdateBatch:
     """All arrangement rows whose key matches a probe key (collision-checked)."""
+    return _gather_materialize_jit(probes, arr, out_cap, kernels.active_backend())
+
+
+@partial(jax.jit, static_argnames=("out_cap", "backend"))
+def _gather_materialize_jit(
+    probes: UpdateBatch, arr: UpdateBatch, out_cap: int, backend: str
+) -> UpdateBatch:
+    with kernels.using_backend(backend):
+        return _gather_materialize_body(probes, arr, out_cap)
+
+
+def _gather_materialize_body(
+    probes: UpdateBatch, arr: UpdateBatch, out_cap: int
+) -> UpdateBatch:
     lo = searchsorted(arr.hashes, probes.hashes, side="left")
     hi = searchsorted(arr.hashes, probes.hashes, side="right")
     counts = jnp.where(probes.live, hi - lo, 0)
@@ -112,17 +143,19 @@ def _gather_materialize(probes: UpdateBatch, arr: UpdateBatch, out_cap: int) -> 
     valid = j < total
     from ..repr.hashing import value_view
 
+    # one fused dtype-grouped gather for the whole arrangement payload
+    a_row = batch_permute(arr, ai)
+    p_keys = kernels.multi_take(probes.keys, pi) if probes.keys else ()
     eq = jnp.ones((out_cap,), dtype=jnp.bool_)
-    for pk, ak in zip(probes.keys, arr.keys):
-        pv, av = value_view(pk), value_view(ak)
-        eq = eq & (pv[pi] == av[ai])
-    ok = valid & eq & (arr.diffs[ai] != 0)
+    for pk, ak in zip(p_keys, a_row.keys):
+        eq = eq & (value_view(pk) == value_view(ak))
+    ok = valid & eq & (a_row.diffs != 0)
     return UpdateBatch(
-        hashes=jnp.where(ok, arr.hashes[ai], PAD_HASH),
-        keys=tuple(jnp.where(ok, k[ai], 0) for k in arr.keys),
-        vals=tuple(jnp.where(ok, v[ai], 0) for v in arr.vals),
-        times=jnp.where(ok, arr.times[ai], PAD_TIME),
-        diffs=jnp.where(ok, arr.diffs[ai], 0),
+        hashes=jnp.where(ok, a_row.hashes, PAD_HASH),
+        keys=tuple(jnp.where(ok, k, 0) for k in a_row.keys),
+        vals=tuple(jnp.where(ok, v, 0) for v in a_row.vals),
+        times=jnp.where(ok, a_row.times, PAD_TIME),
+        diffs=jnp.where(ok, a_row.diffs, 0),
     )
 
 
@@ -144,7 +177,6 @@ def gather_groups(
     return consolidate(advance_times(acc, as_of))
 
 
-@partial(jax.jit, static_argnames=("order_by", "limit", "offset", "nulls_last"))
 def topk_select(
     rows: UpdateBatch, order_by, limit, offset: int, time, nulls_last=None
 ) -> UpdateBatch:
@@ -155,6 +187,25 @@ def topk_select(
     boundary keeps the in-window portion of its diff. `nulls_last` per order
     column; None = pg default (last when ascending, first when descending).
     """
+    return _topk_select(
+        rows, order_by, limit, offset, time, nulls_last, kernels.active_backend()
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("order_by", "limit", "offset", "nulls_last", "backend"),
+)
+def _topk_select(
+    rows: UpdateBatch, order_by, limit, offset: int, time, nulls_last, backend: str
+) -> UpdateBatch:
+    with kernels.using_backend(backend):
+        return _topk_select_body(rows, order_by, limit, offset, time, nulls_last)
+
+
+def _topk_select_body(
+    rows: UpdateBatch, order_by, limit, offset: int, time, nulls_last=None
+) -> UpdateBatch:
     n = rows.cap
     d = jnp.maximum(rows.diffs, 0) * rows.live  # negative multiplicities ignored
     if nulls_last is None:
@@ -171,7 +222,7 @@ def topk_select(
         sort_cols.append(k)
     sort_cols.append(rows.hashes)
     order = sort_perm(sort_cols)
-    b = rows.permute(order)
+    b = batch_permute(rows, order)
     d = d[order]
 
     run_start = ~row_equal_prev((b.hashes, *b.keys))

@@ -93,7 +93,6 @@ def _seg_scan_min(view: jnp.ndarray, reset: jnp.ndarray, take_max: bool):
     return out
 
 
-@partial(jax.jit, static_argnames=("plan", "out_cap"))
 def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> UpdateBatch:
     """All window outputs for the partitions present in `rows`.
 
@@ -101,6 +100,24 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
     full row). Output: one instance per unit of multiplicity, vals = original
     row columns ++ one column per plan.funcs entry, every diff = 1.
     """
+    from . import kernels
+
+    return _window_compute(rows, plan, time, out_cap, kernels.active_backend())
+
+
+@partial(jax.jit, static_argnames=("plan", "out_cap", "backend"))
+def _window_compute(
+    rows: UpdateBatch, plan: WindowPlan, time, out_cap: int, backend: str
+) -> UpdateBatch:
+    from . import kernels
+
+    with kernels.using_backend(backend):
+        return _window_compute_body(rows, plan, time, out_cap)
+
+
+def _window_compute_body(
+    rows: UpdateBatch, plan: WindowPlan, time, out_cap: int
+) -> UpdateBatch:
     n = rows.cap
     # -- one segmented sort of the consolidated rows ------------------------
     nl_tup = plan.nulls_last
@@ -117,7 +134,9 @@ def window_compute(rows: UpdateBatch, plan: WindowPlan, time, out_cap: int) -> U
         sort_cols.append(value_view(k))
     sort_cols.append(rows.hashes)
     order = sort_perm(sort_cols)
-    b = rows.permute(order)
+    from .kernels import batch_permute
+
+    b = batch_permute(rows, order)
     d = (jnp.maximum(b.diffs, 0) * b.live).astype(DIFF_DTYPE)
 
     idx = jnp.arange(n)

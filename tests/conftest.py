@@ -30,6 +30,13 @@ os.environ["XLA_FLAGS"] = flags
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Pallas registers tpu-platform lowering rules at import time, which requires
+# "tpu" to still be a *known* platform name — import it before the factory
+# deregistration below (registering a lowering never creates a backend, so
+# this cannot touch the real TPU pool).
+from jax.experimental import pallas as _pallas  # noqa: E402,F401
+
 try:
     from jax._src import xla_bridge as _xb
 
