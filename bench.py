@@ -326,6 +326,14 @@ def main():
         file=sys.stderr,
     )
     suffix = "_cpu_fallback" if os.environ.get("MZT_BENCH_CPU_FALLBACK") == "1" else ""
+    # device topology in every artifact: a forced-8-device CPU run and a
+    # 1-device run must be distinguishable in the JSON, not just by suffix.
+    # n_devices = what the process could see; mesh_axis = what the measured
+    # tick actually spanned (q3_tick_single is single-chip, so 1 until the
+    # sharded bench variant lands — honest labeling over implied parallelism)
+    import jax
+
+    devs = jax.devices()
     print(
         json.dumps(
             {
@@ -333,6 +341,9 @@ def main():
                 "value": round(tpu_rate, 1),
                 "unit": "updates/sec",
                 "vs_baseline": round(tpu_rate / cpu_rate, 3) if cpu_rate else None,
+                "n_devices": len(devs),
+                "mesh_axis": {"workers": 1},
+                "platform": devs[0].platform if devs else "none",
             }
         )
     )

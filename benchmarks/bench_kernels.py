@@ -140,11 +140,17 @@ def main(argv=None) -> int:
                     flush=True,
                 )
 
+    devs = jax.devices()
     doc = {
         "benchmark": f"kernels{suffix}",
         "platform_suffix": suffix,
         "pallas_interpret": interp,
         "iters": args.iters,
+        # device topology: a forced-8-device CPU run must be distinguishable
+        # from a 1-device run in the artifact (kernel timings are per-device
+        # programs, so mesh_axis is 1 — but n_devices records the ambient)
+        "n_devices": len(devs),
+        "mesh_axis": {"workers": 1},
         "note": (
             "pallas_interpret=true means the Pallas timings are op-by-op XLA "
             "emulation (correctness proof, not kernel performance); compare "

@@ -383,6 +383,14 @@ class Coordinator:
                         f"invalid value for kernel_backend: {stmt.value!r} "
                         f"(expected one of {', '.join(KERNEL_MODES)})"
                     )
+            elif stmt.name == "exchange_backend":
+                from ..parallel.devicemesh import EXCHANGE_MODES
+
+                if str(stmt.value) not in EXCHANGE_MODES:
+                    raise PlanError(
+                        f"invalid value for exchange_backend: {stmt.value!r} "
+                        f"(expected one of {', '.join(EXCHANGE_MODES)})"
+                    )
             try:
                 target.set(stmt.name, stmt.value)
             except KeyError as e:
@@ -1104,40 +1112,31 @@ class Coordinator:
         return self.trace_manager
 
     def _make_dataflow(self, desc, snaps: dict | None = None, trace_reader=None):
-        """Render a DataflowDescription: the fused single-program path when
-        enabled and expressible, else the host-orchestrated operator graph
-        (the rendering-choice analogue of ENABLE_MZ_JOIN_CORE)."""
-        traces = self._traces() if trace_reader is not None else None
-        oplog = bool(self.configs.get("enable_operator_logging"))
-        if bool(self.configs.get("enable_fused_render")):
-            from ..dataflow.fused import FusedCaps, FusedDataflow, FusedUnsupported
+        """Render a DataflowDescription through the shared rendering decision
+        point (`runtime.render_dataflow`): the fused single-program path when
+        enabled and expressible — over a device mesh per `exchange_backend` —
+        else the host-orchestrated operator graph (the rendering-choice
+        analogue of ENABLE_MZ_JOIN_CORE)."""
+        from ..dataflow.fused import FusedCaps
+        from ..dataflow.runtime import render_dataflow
 
-            caps = FusedCaps(
-                ratio=int(self.configs.get("lsm_merge_ratio")),
-                cap_ratio=int(self.configs.get("fused_join_cap_ratio")),
-            )
-            try:
-                df = FusedDataflow(
-                    desc,
-                    caps=caps,
-                    mesh=self.mesh,
-                    traces=traces,
-                    operator_logging=oplog,
-                )
-                if snaps:
-                    # pre-size so the hydration tick doesn't ladder through
-                    # doubling retries on large input snapshots
-                    df.ensure_delta_capacity(
-                        max((int(b.count()) for b in snaps.values()), default=0)
-                    )
-                return df
-            except FusedUnsupported:
-                pass
-        return Dataflow(
+        caps = FusedCaps(
+            ratio=int(self.configs.get("lsm_merge_ratio")),
+            cap_ratio=int(self.configs.get("fused_join_cap_ratio")),
+        )
+        # pre-size so the hydration tick doesn't ladder through doubling
+        # retries on large input snapshots
+        snap_rows = max((int(b.count()) for b in (snaps or {}).values()), default=0)
+        return render_dataflow(
             desc,
-            traces=traces,
+            fused=bool(self.configs.get("enable_fused_render")),
+            exchange_backend=str(self.configs.get("exchange_backend")),
+            mesh=self.mesh,
+            caps=caps,
+            traces=self._traces() if trace_reader is not None else None,
             trace_reader=trace_reader,
-            operator_logging=oplog,
+            operator_logging=bool(self.configs.get("enable_operator_logging")),
+            snap_rows=snap_rows,
         )
 
     def _encode_val(self, v, cd):

@@ -261,6 +261,20 @@ KERNEL_BACKEND = Config(
     "differential testing); takes effect at the next tick render, no restart",
 )
 
+# -- exchange backend (parallel/devicemesh/: on-chip vs host shard exchange) -
+EXCHANGE_BACKEND = Config(
+    "exchange_backend",
+    "auto",
+    "which exchange plane carries the per-operator shard shuffle: 'device' "
+    "renders over a local device mesh with on-chip all_to_all "
+    "(parallel/devicemesh/, requires the fused tick), 'host' force-disables "
+    "the device plane (single-device fused or the host WorkerMesh across "
+    "processes), 'auto' trusts an explicitly provided mesh and otherwise "
+    "forms one only on a real multi-device accelerator; takes effect at the "
+    "next dataflow render, no restart; shipped to clusterd in "
+    "CreateInstance.config (doc/DEVICE_MESH.md decision table)",
+)
+
 ALL_CONFIGS = [
     MV_SINK_SELF_CORRECT,
     CTP_MAX_FRAME_BYTES,
@@ -290,6 +304,7 @@ ALL_CONFIGS = [
     ENABLE_JAX_PROFILER,
     JAX_PROFILER_DIR,
     KERNEL_BACKEND,
+    EXCHANGE_BACKEND,
 ]
 
 

@@ -120,6 +120,15 @@ INTROSPECTION_TABLES = {
         ("backend", ColType.STRING),
         ("dispatches", ColType.INT64),
     ),
+    "mz_device_mesh": _desc(
+        ("position", ColType.INT64),
+        ("device", ColType.STRING),
+        ("platform", ColType.STRING),
+        ("axis", ColType.STRING),
+        ("axis_size", ColType.INT64),
+        ("in_mesh", ColType.BOOL),
+        ("exchange_backend", ColType.STRING),
+    ),
     "mz_arrangement_sizes": _desc(
         ("dataflow", ColType.STRING),
         ("operator_id", ColType.INT64),
@@ -279,6 +288,20 @@ def introspection_rows(coord, name: str) -> list[tuple]:
                 _kernels.dispatch_counts().items()
             )
         ]
+    if name == "mz_device_mesh":
+        # one row per local device: mesh membership of the exchange plane
+        # (parallel/devicemesh/). With no mesh-rendered dataflow (host mode)
+        # the devices still list with in_mesh=false and axis_size=0, so the
+        # table answers "what COULD a device mesh use here" anywhere.
+        from ..parallel.devicemesh import device_mesh_rows
+
+        mesh = getattr(coord, "mesh", None)
+        for _gid, df, _src in coord.dataflows:
+            m = getattr(df, "mesh", None)
+            if m is not None:
+                mesh = m
+                break
+        return device_mesh_rows(mesh, str(coord.configs.get("exchange_backend")))
     if name == "mz_arrangement_sizes":
         out = []
         for gid, df, _src in coord.dataflows:

@@ -1,6 +1,7 @@
 """The mzlint pass registry: import a pass module, list its rules here."""
 
 from .blocking import BlockingUnderLock
+from .collective_rule import CollectiveCoherence
 from .crashsafety import CrashSwallow, DurableCleanup
 from .dtype64 import Dtype64
 from .hygiene import ListenerHygiene
@@ -24,6 +25,7 @@ ALL_RULES = [
     CtpCoherence(),
     ListenerHygiene(),
     KernelDispatchCoherence(),
+    CollectiveCoherence(),
     MetricsCoherence(),
 ]
 

@@ -1,0 +1,165 @@
+"""collective-coherence: device collectives stay inside the exchange plane.
+
+The device exchange plane (materialize_tpu/parallel/devicemesh/) is the one
+module family allowed to issue XLA collectives. Three lexical invariants
+keep it that way:
+
+  1. ``psum``/``all_to_all``/``ppermute``/``all_gather``/``psum_scatter``/
+     ``shard_map`` calls are confined to ``parallel/devicemesh/`` — a
+     collective elsewhere escapes the mesh_jit program counter, the
+     transfer-guard differentials and the axis-name discipline;
+  2. a collective called with a string-literal axis name must use the ONE
+     mesh axis the engine defines (``WORKERS`` in parallel/mesh.py) — a
+     typo'd axis is an unbound-axis error deep inside a compiled tick, or
+     worse, a silently unsharded reduce on a multi-axis mesh;
+  3. no host callbacks inside the device plane: ``io_callback``/
+     ``pure_callback``/``device_get`` and ``np.*`` calls are banned in
+     ``parallel/devicemesh/`` function bodies — the tick must stay on
+     device end to end (the transfer_guard("disallow") contract the tests
+     assert), and a host pull inside a shard_mapped function either crashes
+     under jit or serializes every device through the host.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted, terminal_name
+from ..core import Finding, Project, Rule
+
+_DEVICEMESH_DIR = "materialize_tpu/parallel/devicemesh/"
+_MESH_DEF = "materialize_tpu/parallel/mesh.py"
+
+#: collective / mesh-program primitives confined to the device plane
+COLLECTIVES = {
+    "psum",
+    "psum_scatter",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_to_all",
+    "all_gather",
+    "ppermute",
+    "pshuffle",
+    "shard_map",
+}
+
+#: axis argument position for axis-literal checking: fn(operand, axis, ...)
+_AXIS_ARG_INDEX = 1
+
+#: host-pull calls banned inside the device plane
+_HOST_CALLBACKS = {"io_callback", "pure_callback", "device_get"}
+
+
+def _axis_literal(call: ast.Call) -> tuple[str, int] | None:
+    """(axis string, lineno) when the call names its axis with a literal."""
+    for kw in call.keywords:
+        if kw.arg == "axis_name" and isinstance(kw.value, ast.Constant):
+            if isinstance(kw.value.value, str):
+                return kw.value.value, kw.value.lineno
+    if len(call.args) > _AXIS_ARG_INDEX:
+        a = call.args[_AXIS_ARG_INDEX]
+        if isinstance(a, ast.Constant) and isinstance(a.value, str):
+            return a.value, a.lineno
+    return None
+
+
+def _declared_axis(project: Project) -> str:
+    """The engine's one mesh axis: the WORKERS literal in parallel/mesh.py."""
+    for sf in project.files:
+        if not sf.rel.endswith("parallel/mesh.py"):
+            continue
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if (
+                    isinstance(t, ast.Name)
+                    and t.id == "WORKERS"
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    return node.value.value
+    return "workers"
+
+
+class CollectiveCoherence(Rule):
+    id = "collective-coherence"
+    description = (
+        "device collectives confined to parallel/devicemesh/; literal axis "
+        "names match the mesh definition; no host callbacks or np.* pulls "
+        "inside the device plane"
+    )
+
+    def check_project(self, project: Project):
+        axis = _declared_axis(project)
+
+        for sf in project.files:
+            if not sf.rel.startswith("materialize_tpu/"):
+                continue
+            in_plane = sf.rel.startswith(_DEVICEMESH_DIR)
+            # function spans for the host-callback scope (rule 3): calls at
+            # module level (metric registration, mode tables) are config,
+            # not tick-time host pulls
+            fn_spans = []
+            if in_plane:
+                for node in ast.walk(sf.tree):
+                    if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fn_spans.append((node.lineno, node.end_lineno or node.lineno))
+
+            for node in ast.walk(sf.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = terminal_name(node.func)
+
+                if fn in COLLECTIVES:
+                    if not in_plane:
+                        yield Finding(
+                            self.id,
+                            sf.rel,
+                            node.lineno,
+                            f"{fn} outside {_DEVICEMESH_DIR} — device "
+                            "collectives must live in the exchange plane "
+                            "(mesh_jit program metrics + axis discipline + "
+                            "transfer-guard differentials)",
+                        )
+                        continue
+                    lit = _axis_literal(node)
+                    if lit is not None and lit[0] != axis:
+                        yield Finding(
+                            self.id,
+                            sf.rel,
+                            lit[1],
+                            f"{fn} names axis {lit[0]!r} but the mesh "
+                            f"definition ({_MESH_DEF} WORKERS) declares "
+                            f"{axis!r} — collectives must ride the one "
+                            "worker axis",
+                        )
+
+                elif in_plane:
+                    inside_fn = any(
+                        lo <= node.lineno <= hi for lo, hi in fn_spans
+                    )
+                    if not inside_fn:
+                        continue
+                    d = dotted(node.func)
+                    if fn in _HOST_CALLBACKS:
+                        yield Finding(
+                            self.id,
+                            sf.rel,
+                            node.lineno,
+                            f"{fn} inside the device plane — host callbacks "
+                            "break the on-device tick contract "
+                            "(transfer_guard('disallow') in tests)",
+                        )
+                    elif d is not None and (
+                        d.startswith("np.") or d.startswith("numpy.")
+                    ):
+                        yield Finding(
+                            self.id,
+                            sf.rel,
+                            node.lineno,
+                            f"{d} inside the device plane — numpy executes "
+                            "on host; device-plane functions must stay jnp/"
+                            "lax so the jitted tick never leaves the chip",
+                        )

@@ -31,6 +31,9 @@ REQUIRED_FAMILIES = (
     "mzt_heartbeat_rtt_seconds",
     "mzt_dataflow_tick_duration_ns",
     "mzt_kernel_dispatch_total",
+    "mzt_device_exchange_programs_total",
+    "mzt_device_exchange_mesh_devices",
+    "mzt_device_exchange_retries_total",
 )
 
 _BUMP = re.compile(r'(?:\.bump|\.record_max)\(\s*"([a-z_]+)"')
@@ -69,6 +72,7 @@ def lint(root: Path | None = None) -> list:
     # import the subsystems whose module-level registrations we assert on
     import materialize_tpu.cluster.controller  # noqa: F401
     import materialize_tpu.cluster.mesh  # noqa: F401
+    import materialize_tpu.parallel.devicemesh.exchange  # noqa: F401
     import materialize_tpu.persist.location  # noqa: F401
     from materialize_tpu.adapter import Coordinator
     from materialize_tpu.adapter.introspection import (

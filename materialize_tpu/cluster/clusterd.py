@@ -187,6 +187,13 @@ class ClusterState:
                 kernels.set_kernel_backend(str(cfg.get("kernel_backend", "auto")))
             except ValueError:
                 pass  # unknown value in an old snapshot: keep the default
+            # exchange backend is read per-render (_create_dataflow), not set
+            # globally; sanitize here so an unknown value in an old snapshot
+            # degrades to auto instead of failing every later render
+            from ..parallel.devicemesh import EXCHANGE_MODES
+
+            if str(cfg.get("exchange_backend", "auto")) not in EXCHANGE_MODES:
+                cfg["exchange_backend"] = "auto"
             return p.Frontiers({})
         if isinstance(cmd, p.FetchStats):
             return self._fetch_stats()
@@ -279,8 +286,25 @@ class ClusterState:
         # the handle's hydration frame (TraceHandle.as_of) keys off desc.as_of
         cmd.desc.as_of = cmd.as_of
         try:
-            df = Dataflow(
+            # whole-replica mode renders through the shared decision point:
+            # this process owns every shard of the dataflow, so a device mesh
+            # (exchange_backend=device/auto in the dyncfg snapshot) can carry
+            # the exchange on-chip. Sharded mode below stays host-rendered —
+            # its worker partitions are not key-closed (doc/DEVICE_MESH.md).
+            from ..dataflow.fused import FusedCaps
+            from ..dataflow.runtime import render_dataflow
+
+            caps = FusedCaps(
+                ratio=int(self.config.get("lsm_merge_ratio", FusedCaps().ratio)),
+                cap_ratio=int(
+                    self.config.get("fused_join_cap_ratio", FusedCaps().cap_ratio)
+                ),
+            )
+            df = render_dataflow(
                 cmd.desc,
+                fused=bool(self.config.get("enable_fused_render", False)),
+                exchange_backend=str(self.config.get("exchange_backend", "auto")),
+                caps=caps,
                 traces=self.traces,
                 trace_reader=cmd.dataflow_id,
                 operator_logging=bool(

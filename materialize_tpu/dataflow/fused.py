@@ -851,20 +851,15 @@ class FusedDataflow:
         else:
             from jax.sharding import PartitionSpec as P
 
-            try:
-                shard_map = jax.shard_map
-            except AttributeError:  # older jax
-                from jax.experimental.shard_map import shard_map as _sm
+            from ..parallel.devicemesh import mesh_jit
 
-                shard_map = _sm
             spec, rep = P(self.axis_name), P()
-            self._tick = jax.jit(
-                shard_map(
-                    tick,
-                    mesh=self.mesh,
-                    in_specs=(spec, spec, rep, rep),
-                    out_specs=(spec, spec, spec, spec, spec),
-                )
+            self._tick = mesh_jit(
+                tick,
+                self.mesh,
+                in_specs=(spec, spec, rep, rep),
+                out_specs=(spec, spec, spec, spec, spec),
+                axis_name=self.axis_name,
             )
 
     def _tiled_template(self) -> dict:
@@ -947,12 +942,22 @@ class FusedDataflow:
             deltas[cid] = self._const_delta(cid, c, tick, delta_cap)
 
         with _prof.annotate(f"mzt_fused_tick:{self._profile_name}"):
+            # stage the time scalars on device EAGERLY: inside the jitted call
+            # a bare np.uint32 is an implicit host→device transfer, which the
+            # transfer_guard("disallow") differentials (conftest
+            # device_tick_guard) rightly reject
+            t_dev = jnp.asarray(device_time_scalar(tick))
+            s_dev = jnp.asarray(device_time_scalar(self.since))
             state2, outs, errs, over, counts = self._tick(
-                self.state, deltas, device_time_scalar(tick), device_time_scalar(self.since)
+                self.state, deltas, t_dev, s_dev
             )
         if bool(np.asarray(over).any()):
             # lossless retry: drop results, double capacities, re-run the
             # same tick from the unchanged pre-tick state
+            if self.mesh is not None:
+                from ..parallel.devicemesh import note_overflow_retry
+
+                note_overflow_retry()
             self.retries += 1
             self._elapsed_ns += _time.perf_counter_ns() - t0
             self._scale *= 2

@@ -2029,3 +2029,56 @@ def _expr_dtype(expr, col_dtypes):
             out = np.promote_types(out, d)
         return out
     raise TypeError(f"not a ScalarExpr: {expr!r}")
+
+
+def render_dataflow(
+    desc: lir.DataflowDescription,
+    *,
+    fused: bool = False,
+    exchange_backend: str = "auto",
+    mesh=None,
+    caps=None,
+    traces=None,
+    trace_reader: str | None = None,
+    operator_logging: bool = False,
+    snap_rows: int = 0,
+):
+    """Render a DataflowDescription under the exchange-backend policy.
+
+    The ONE rendering decision point shared by the coordinator (local
+    replicas) and clusterd (remote whole-replica mode): `exchange_backend`
+    (host/device/auto, the dyncfg) picks the exchange plane via
+    `devicemesh.resolve_exchange_mesh`, then the fused single-program render
+    is attempted when requested (or implied by a device mesh — the device
+    plane only exists inside the fused tick) and the host-orchestrated
+    operator graph is the fallback for plans fused can't express
+    (the rendering-choice analogue of ENABLE_MZ_JOIN_CORE).
+
+    `snap_rows` pre-sizes fused delta capacity so a hydration tick does not
+    ladder through doubling retries.
+    """
+    from ..parallel.devicemesh import resolve_exchange_mesh
+
+    dmesh = resolve_exchange_mesh(exchange_backend, mesh)
+    if fused or exchange_backend == "device":
+        from .fused import FusedDataflow, FusedUnsupported
+
+        try:
+            df = FusedDataflow(
+                desc,
+                caps=caps,
+                mesh=dmesh,
+                traces=traces,
+                operator_logging=operator_logging,
+            )
+            if snap_rows:
+                df.ensure_delta_capacity(int(snap_rows))
+            return df
+        except FusedUnsupported:
+            pass
+    return Dataflow(
+        desc,
+        traces=traces,
+        trace_reader=trace_reader,
+        operator_logging=operator_logging,
+    )

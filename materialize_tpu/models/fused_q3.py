@@ -399,17 +399,12 @@ def q3_tick_sharded(mesh, caps: Q3Caps, axis_name: str = "workers"):
             caps=caps, axis_name=axis_name, n_shards=n,
         )
 
-    try:
-        shard_map = jax.shard_map
-    except AttributeError:  # older jax
-        from jax.experimental.shard_map import shard_map as _sm
+    from ..parallel.devicemesh import mesh_jit
 
-        shard_map = _sm
-    return jax.jit(
-        shard_map(
-            step,
-            mesh=mesh,
-            in_specs=(spec, spec, spec, spec, rep),
-            out_specs=(spec, spec, spec, spec),
-        )
+    return mesh_jit(
+        step,
+        mesh,
+        in_specs=(spec, spec, spec, spec, rep),
+        out_specs=(spec, spec, spec, spec),
+        axis_name=axis_name,
     )

@@ -1,7 +1,7 @@
 """Pluggable device-kernel layer for the tick hot path (doc/KERNELS.md).
 
-Three kernels, each registered with its XLA lowering (the reference oracle)
-AND a hand-written Pallas program, selected by the `kernel_backend` dyncfg:
+Each kernel is registered with its XLA lowering (the reference oracle) AND a
+hand-written Pallas program, selected by the `kernel_backend` dyncfg:
 
 - ``run_sum``   — segmented-sum-by-run over a canonically ordered batch
                   (segsum.py; backs consolidate / merge_consolidate /
@@ -11,6 +11,9 @@ AND a hand-written Pallas program, selected by the `kernel_backend` dyncfg:
                   topk two-pass gathers)
 - ``probe``/``probe2`` — batched fixed-depth binary search, keys
                   VMEM-resident (probe.py; backs ops/search.py)
+- ``route_dest``/``bucket_rank`` — exchange routing: u32-hash destination
+                  map and rank-within-destination-run (route.py; backs the
+                  device exchange plane, parallel/devicemesh/exchange.py)
 
 The contract is bit-identity: a Pallas backend must produce byte-identical
 output to its XLA reference on every input. See registry.py for backend
@@ -35,5 +38,5 @@ from .registry import (  # noqa: F401
 )
 
 # importing the kernel modules registers their backends
-from . import permute, probe, segsum  # noqa: E402,F401
+from . import permute, probe, route, segsum  # noqa: E402,F401
 from .permute import batch_permute, multi_take  # noqa: F401

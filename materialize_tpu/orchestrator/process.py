@@ -17,15 +17,29 @@ import time
 from dataclasses import dataclass, field
 
 
-def _replica_env(cpu: bool) -> dict:
+def _replica_env(cpu: bool, devices_per_process: int | None = None) -> dict:
     """Environment for spawned replicas. With cpu=True the platform must be
     pinned BEFORE interpreter start: materialize_tpu's import-time gates (the
     persistent compile cache with its AOT SIGILL risk, the axon plugin) read
-    the env before clusterd's --cpu flag is ever parsed."""
+    the env before clusterd's --cpu flag is ever parsed.
+
+    `devices_per_process` forces that many virtual host devices in each
+    replica (XLA_FLAGS, read at backend init — same mechanism as
+    tests/conftest.py), so a replica can form an intra-process device mesh
+    (parallel/devicemesh/) UNDER the cross-process host mesh — the 2 proc ×
+    N devices composition."""
     env = dict(os.environ)
     if cpu:
         env["JAX_PLATFORMS"] = "cpu"
         env["MZT_NO_COMPILE_CACHE"] = "1"
+    if devices_per_process is not None:
+        flag = f"--xla_force_host_platform_device_count={int(devices_per_process)}"
+        prior = env.get("XLA_FLAGS", "")
+        kept = [
+            f for f in prior.split()
+            if not f.startswith("--xla_force_host_platform_device_count=")
+        ]
+        env["XLA_FLAGS"] = " ".join(kept + [flag]).strip()
     return env
 
 
@@ -45,13 +59,19 @@ class Service:
 
 
 class ProcessOrchestrator:
-    def __init__(self, cpu: bool = True, extra_env: dict | None = None):
+    def __init__(
+        self,
+        cpu: bool = True,
+        extra_env: dict | None = None,
+        devices_per_process: int | None = None,
+    ):
         # `extra_env`: additional environment for spawned replicas — the
         # chaos tests ship the seeded fault schedule (MZT_FAULT_SPEC,
         # cluster/faults.py) to clusterd subprocesses this way
         self.services: dict[str, Service] = {}
         self.cpu = cpu
         self.extra_env = dict(extra_env or {})
+        self.devices_per_process = devices_per_process
 
     def _spawn(self, port: int, mesh_port: int | None):
         args = [
@@ -65,7 +85,7 @@ class ProcessOrchestrator:
             args += ["--mesh-port", str(mesh_port)]
         if self.cpu:
             args.append("--cpu")
-        env = _replica_env(self.cpu)
+        env = _replica_env(self.cpu, self.devices_per_process)
         env.update(self.extra_env)
         return subprocess.Popen(args, env=env)
 

@@ -1,10 +1,13 @@
-from .exchange import exchange, route_to_buckets
+from .devicemesh import exchange, mesh_jit, resolve_exchange_mesh, route_to_buckets
 from .fused import arrangement_insert, fused_accumulable_step, fused_join_delta
 from .mesh import WORKERS, make_mesh
 from .netexchange import merge_parts, partition_batch, partition_cols
+from .routing import route_mod
 
 __all__ = [
     "exchange",
+    "mesh_jit",
+    "resolve_exchange_mesh",
     "route_to_buckets",
     "arrangement_insert",
     "fused_accumulable_step",
@@ -14,4 +17,5 @@ __all__ = [
     "merge_parts",
     "partition_batch",
     "partition_cols",
+    "route_mod",
 ]

@@ -1,0 +1,30 @@
+"""Device-collective exchange plane: one jitted tick across a device mesh.
+
+The subsystem that puts the shard mesh ON the chip (ROADMAP item landed by
+PR 16): mesh policy + formation in `mesh.py`, the on-device all_to_all
+exchange and the `mesh_jit` program builder in `exchange.py`. Host planes
+(`parallel/netexchange.py` across processes, single-device fused) remain and
+compose — the `exchange_backend` dyncfg picks per the decision table in
+doc/DEVICE_MESH.md.
+"""
+
+from .exchange import exchange, mesh_jit, note_overflow_retry, route_to_buckets
+from .mesh import (
+    EXCHANGE_MODES,
+    device_mesh_rows,
+    form_device_mesh,
+    local_device_count,
+    resolve_exchange_mesh,
+)
+
+__all__ = [
+    "EXCHANGE_MODES",
+    "device_mesh_rows",
+    "exchange",
+    "form_device_mesh",
+    "local_device_count",
+    "mesh_jit",
+    "note_overflow_retry",
+    "resolve_exchange_mesh",
+    "route_to_buckets",
+]

@@ -1,0 +1,94 @@
+"""Device-mesh formation and the host-vs-device exchange policy.
+
+The device exchange plane runs ONE jitted tick over a 1-D
+`jax.sharding.Mesh` of local devices (axis: `parallel.mesh.WORKERS`); the
+per-operator shuffle inside it is an on-device collective
+(`devicemesh/exchange.py`), not host-staged frames. This module decides WHEN
+that plane applies (`resolve_exchange_mesh`, driven by the `exchange_backend`
+dyncfg) and reports WHAT it formed (`device_mesh_rows` backs the
+`mz_device_mesh` introspection table).
+
+Policy (the decision table in doc/DEVICE_MESH.md):
+
+- ``host``   — never form a device mesh; the existing host planes
+  (single-device fused, or `cluster/mesh.py` WorkerMesh across processes)
+  carry everything. The force-disable escape hatch.
+- ``device`` — always use the mesh the caller provided, or form one over
+  ALL local devices if none was given. Errors surface at render time.
+- ``auto``   — use a caller-provided mesh as-is; otherwise form one only
+  when the backend is a real accelerator (`tpu`/`gpu`) with >1 local
+  device. On CPU a forced 8-device mesh is a test harness, not a win, so
+  auto stays host unless the caller opted in by building a mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ..mesh import WORKERS, make_mesh
+
+EXCHANGE_MODES = ("auto", "host", "device")
+
+_ACCEL_PLATFORMS = ("tpu", "gpu")
+
+
+def local_device_count() -> int:
+    """Local addressable devices (8 under the conftest CPU forcing)."""
+    return jax.local_device_count()
+
+
+def form_device_mesh(n_devices: int | None = None, axis_name: str = WORKERS):
+    """A 1-D device mesh over `n_devices` local devices (all, if None)."""
+    return make_mesh(n_devices, axis_name=axis_name)
+
+
+def resolve_exchange_mesh(mode: str, mesh=None):
+    """Apply the `exchange_backend` policy: the mesh to render over, or None.
+
+    None means "host plane" — the renderer falls back to the single-device
+    fused tick or the interpreted runtime exactly as before this plane
+    existed.
+    """
+    if mode not in EXCHANGE_MODES:
+        raise ValueError(
+            f"exchange_backend must be one of {EXCHANGE_MODES}, got {mode!r}"
+        )
+    if mode == "host":
+        return None
+    if mode == "device":
+        return mesh if mesh is not None else form_device_mesh()
+    # auto: trust an explicit mesh; otherwise only a real multi-device chip
+    if mesh is not None:
+        return mesh
+    if jax.default_backend() in _ACCEL_PLATFORMS and jax.local_device_count() > 1:
+        return form_device_mesh()
+    return None
+
+
+def device_mesh_rows(mesh, backend: str):
+    """Rows for `mz_device_mesh`: one per local device, mesh membership
+    marked. `mesh` may be None (host mode) — devices still listed so the
+    table answers "what could a device mesh use here" on any deployment.
+    """
+    axis = ""
+    axis_size = 0
+    members = frozenset()
+    if mesh is not None:
+        axis = str(mesh.axis_names[0])
+        axis_size = int(mesh.shape[axis])
+        members = frozenset(int(d.id) for d in mesh.devices.flat)
+    rows = []
+    for pos, dev in enumerate(jax.local_devices()):
+        plat = str(dev.platform)
+        rows.append(
+            (
+                pos,
+                f"{plat}:{int(dev.id)}",
+                plat,
+                axis,
+                axis_size,
+                int(dev.id) in members,
+                str(backend),
+            )
+        )
+    return rows

@@ -1,83 +1,14 @@
-"""Worker exchange: hash-routed all_to_all over a device mesh.
+"""Back-compat shim: the on-device exchange moved to `parallel/devicemesh/`.
 
-The TPU re-design of timely's key-sharded exchange pacts and zero-copy TCP
-mesh (reference: src/timely-util/src/pact.rs,
-src/cluster/src/communication.rs:100): instead of per-worker sockets, every
-tick's shuffle is ONE `lax.all_to_all` over the mesh axis riding ICI.
-
-Routing is static-shape: each device packs its rows into `n_dest` buckets of
-fixed capacity (rank-within-destination computed by one sort + segmented
-arange), sends bucket i to device i, and flattens what it receives. Overflow
-(more rows for one destination than bucket capacity) is detected and reported
-as a flag the host can react to by re-running the tick with bigger buckets —
-the same bucketing discipline used everywhere else in the engine.
+The hash-routed all_to_all (`route_to_buckets`/`exchange`) now lives in
+`devicemesh/exchange.py`, the single module allowed to issue device
+collectives (collective-coherence mzlint pass). Import from
+`materialize_tpu.parallel` or `materialize_tpu.parallel.devicemesh`; this
+module only re-exports so pre-PR-16 call sites keep working.
 """
 
 from __future__ import annotations
 
-from functools import partial
+from .devicemesh.exchange import exchange, route_to_buckets
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from ..ops.search import sort_perm
-from ..repr.batch import PAD_TIME, UpdateBatch
-from ..repr.hashing import PAD_HASH
-
-
-def route_to_buckets(batch: UpdateBatch, n_dest: int, bucket_cap: int):
-    """Pack rows into [n_dest, bucket_cap] buckets by hash % n_dest.
-
-    Returns (buckets pytree of [n_dest, bucket_cap] arrays, overflow flag).
-    Dead rows (padding / diff 0) are not routed.
-    """
-    cap = batch.cap
-    live = batch.live
-    dest = (batch.hashes % jnp.uint32(n_dest)).astype(jnp.int32)
-    key = jnp.where(live, dest, n_dest)  # dead rows to a discard bucket
-    order = sort_perm((key,))  # stable, i32 iota — no 64-bit sort operand
-    key_s = key[order]
-    # rank within each destination run
-    idx = jnp.arange(cap, dtype=jnp.int32)
-    run_start = jnp.concatenate(
-        [jnp.ones((1,), dtype=jnp.bool_), key_s[1:] != key_s[:-1]]
-    )
-    first_idx = jax.lax.cummax(jnp.where(run_start, idx, -1))
-    rank = idx - first_idx
-    overflow = jnp.any((key_s < n_dest) & (rank >= bucket_cap))
-    ok = (key_s < n_dest) & (rank < bucket_cap)
-    # non-routed rows scatter OUT OF BOUNDS so mode="drop" discards them —
-    # aiming them at [0,0] would clobber whatever real row lives there
-    d_idx = jnp.where(ok, key_s, n_dest)
-    s_idx = jnp.where(ok, rank, bucket_cap)
-
-    def scatter(col, fill):
-        out = jnp.full((n_dest, bucket_cap), fill, dtype=col.dtype)
-        return out.at[d_idx, s_idx].set(col[order], mode="drop")
-
-    buckets = UpdateBatch(
-        hashes=scatter(batch.hashes, PAD_HASH),
-        keys=tuple(scatter(k, 0) for k in batch.keys),
-        vals=tuple(scatter(v, 0) for v in batch.vals),
-        times=scatter(batch.times, PAD_TIME),
-        diffs=scatter(batch.diffs, 0),
-    )
-    return buckets, overflow
-
-
-def exchange(batch: UpdateBatch, axis_name: str, n_dest: int, bucket_cap: int):
-    """All-to-all shuffle by key hash (call under shard_map over `axis_name`).
-
-    Every row lands on the device owning `hash % n_dest`. Returns
-    (received batch of capacity n_dest*bucket_cap, overflow flag for THIS
-    device's send side — psum it for a global flag).
-    """
-    buckets, overflow = route_to_buckets(batch, n_dest, bucket_cap)
-
-    def a2a(x):
-        return jax.lax.all_to_all(x, axis_name, 0, 0)
-
-    recv = jax.tree_util.tree_map(a2a, buckets)
-    flat = jax.tree_util.tree_map(lambda x: x.reshape((-1,) + x.shape[2:]), recv)
-    return flat, overflow
+__all__ = ["exchange", "route_to_buckets"]

@@ -6,15 +6,16 @@ with a single `all_to_all` riding ICI. This module is the same pact at the
 `src/cluster/src/communication.rs:100`): update batches are staged to host,
 hash-partitioned by key columns with the engine's canonical row hash, and the
 per-destination column dicts ride the framed CTP transport between shard
-processes (`cluster/mesh.py`). Host-staged pickled frames are the documented
-v1; a DCN collective (or zero-copy buffers) slots in behind the same
-`partition_batch`/`merge_parts` seam without touching the renderer.
+processes (`cluster/mesh.py`). The on-device collective counterpart landed
+in `parallel/devicemesh/` (exchange_backend=device): inside one process the
+shuffle is a single `lax.all_to_all`; this host plane remains the cross-host
+seam, and the two compose (doc/DEVICE_MESH.md decision table).
 
 Routing invariant: a row's destination worker depends only on the VALUES of
-its routing columns (`hash_columns` % n_workers — the same u32 hash the
-device exchange and every arrangement uses), never on batch boundaries or
-arrival order, so an insert and its later retraction always land on the same
-worker and sharded results are deterministic.
+its routing columns (`routing.route_mod` of the canonical u32 row hash — the
+same rule the device exchange and every arrangement uses), never on batch
+boundaries or arrival order, so an insert and its later retraction always
+land on the same worker and sharded results are deterministic.
 """
 
 from __future__ import annotations
@@ -25,6 +26,7 @@ import numpy as np
 
 from ..repr.batch import UpdateBatch
 from ..repr.hashing import hash_columns_np
+from .routing import route_mod
 
 
 def batch_to_cols(batch: Optional[UpdateBatch]) -> Optional[dict]:
@@ -64,8 +66,8 @@ def route_dests(cols: dict, key_cols, n_workers: int) -> np.ndarray:
     if not picked:
         return np.zeros(nrows, dtype=np.int64)
     hashes = hash_columns_np(tuple(picked))
-    # u32 hash mod n directly — same routing as the u64 cast, no widening
-    return (hashes % np.uint32(n_workers)).astype(np.int64)
+    # the ONE routing rule shared with the device plane (routing.route_mod)
+    return route_mod(hashes, n_workers).astype(np.int64)
 
 
 def partition_cols(cols: Optional[dict], key_cols, n_workers: int) -> list:

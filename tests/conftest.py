@@ -54,6 +54,36 @@ def rng():
     return np.random.default_rng(0)
 
 
+@pytest.fixture
+def device_tick_guard():
+    """Wrap a dataflow's jitted tick in jax.transfer_guard("disallow").
+
+    The CI assertion for the device exchange plane (doc/DEVICE_MESH.md): once
+    installed, ANY host transfer issued while the jitted tick runs — an
+    np.asarray pull, an implicit numpy-operand upload, an io_callback — fails
+    the test loudly instead of silently serializing the mesh through the
+    host. Install AFTER the first step: compilation itself transfers jit
+    constants host→device once, which is legitimate and unrepeated.
+
+    Guards both host directions only; device↔device movement (shard_map
+    resharding inputs onto the mesh) is the exchange plane's job and stays
+    allowed.
+    """
+
+    def install(df):
+        inner = df._tick
+
+        def guarded_tick(*args, **kwargs):
+            with jax.transfer_guard_host_to_device("disallow"), \
+                    jax.transfer_guard_device_to_host("disallow"):
+                return inner(*args, **kwargs)
+
+        df._tick = guarded_tick
+        return df
+
+    return install
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_between_modules():
     """Free compiled XLA executables after each test module.
