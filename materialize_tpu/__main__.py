@@ -52,6 +52,9 @@ def cmd_serve(args) -> None:
         serve_pgwire(
             coord, host=args.host, port=args.pg_port,
             lock=httpd.RequestHandlerClass.lock,
+            # one event loop serves both frontends when the reactor
+            # backend is active (threaded httpd has no reactor attribute)
+            reactor=getattr(httpd, "reactor", None),
         )
         print(f"pgwire listening on {args.host}:{args.pg_port}", flush=True)
     if args.advance_every > 0:

@@ -220,10 +220,17 @@ class Coordinator:
         # become "fenced" when a newer generation takes over.
         self.deploy_state = "init"
         self.epoch = 0
-        # egress plane (materialize_tpu/egress): push SUBSCRIBE queues and
-        # exactly-once file sinks, both fed by _apply_writes' egress tick
+        # egress plane (materialize_tpu/egress): push SUBSCRIBE cursors over
+        # the shared fan-out ring, and exactly-once file sinks, both fed by
+        # _apply_writes' egress tick. One frame per (collection, tick) is
+        # published into `fanout` and shared zero-copy by every subscriber.
+        from ..egress import FanoutTree
+
         self.subscriptions: dict[str, Any] = {}
         self.sinks: dict[str, Any] = {}
+        self.fanout = FanoutTree(
+            retention=lambda: int(self.configs.get("fanout_ring_ticks"))
+        )
         self._sub_seq = 0
         self._register_introspection()
         if self.durable:
@@ -465,6 +472,20 @@ class Coordinator:
         COPY-out rows and the HTTP server as NDJSON, while
         `poll_subscription` remains the pull shape."""
         from ..egress import Subscription
+        from ..errors import TooManySubscriptions
+
+        # per-tenant admission budget (on top of the PR 6 gates): one user
+        # may not exhaust the fan-out ring's cursor table; retryable 53300
+        user = getattr(getattr(self, "_session", None), "user", None) or "anonymous"
+        per_user = int(self._cfg().get("max_subscriptions_per_user"))
+        if per_user > 0:
+            live = sum(1 for s in self.subscriptions.values() if s.user == user)
+            if live >= per_user:
+                self.overload.bump("subscriptions_rejected")
+                raise TooManySubscriptions(
+                    f"user {user!r} already holds {live} subscriptions "
+                    f"(max_subscriptions_per_user = {per_user}); retry later"
+                )
 
         pq = self.planner.plan_query(stmt.query)
         rel = optimize(pq.mir, self.configs)
@@ -485,12 +506,17 @@ class Coordinator:
             (it.name for it in self.catalog.items.values() if it.global_id == gid),
             gid,
         )
+        columns = tuple(c.name for c in pq.desc.columns)
         sub = Subscription(
-            sub_id, gid, obj_name, pq,
-            tuple(c.name for c in pq.desc.columns),
+            sub_id, gid, obj_name, pq, columns,
             snapshot=stmt.snapshot, progress=stmt.progress,
             max_depth=int(self._cfg().get("subscribe_queue_depth")),
             hidden_mv=hidden,
+            # the cursor attaches at the shared ring's head: ticks from now
+            # on arrive as shared frames, the snapshot below as a private
+            # preamble (it is at this subscriber's own as_of)
+            channel=self.fanout.channel(gid, columns),
+            user=user,
         )
         as_of = self.oracle.read_ts()
         updates = []
@@ -500,6 +526,12 @@ class Coordinator:
                 lambda r: self._decode_row(r, pq),
             )
         sub.frontier = as_of + 1
+        # pin the decode schema and seed the read hold on the CHANNEL: the
+        # tick loop and the compaction driver iterate channels, never the
+        # (possibly 10k-wide) subscriber population
+        sub.channel.pq = pq
+        if sub.channel.frontier <= as_of:
+            sub.channel.frontier = as_of + 1
         if updates or stmt.progress:
             sub.publish(updates, progress_ts=(as_of + 1) if stmt.progress else None)
         self.subscriptions[sub_id] = sub
@@ -673,26 +705,38 @@ class Coordinator:
         from ..egress import progress_shard_id
         from ..persist import Fenced
 
-        for sub_id, sub in list(self.subscriptions.items()):
-            batch = env.get(sub.gid)
+        # each (collection, columns) channel decodes and publishes ONE frame
+        # entry per tick, shared zero-copy by every cursor — fan-out work is
+        # O(channels), not O(subscribers): per-cursor accounting is the
+        # channel's O(1) floor check (Channel.shared_tick), and the read
+        # hold advances once per channel, not once per subscriber
+        for ch in self.fanout.live():
+            if not ch.cursors:
+                continue  # last cursor detached under us; reaped below
+            batch = env.get(ch.gid)
             updates = (
                 self._batch_updates(
-                    batch, lambda r, s=sub: self._decode_row(r, s.pq)
+                    batch, lambda r, p=ch.pq: self._decode_row(r, p)
                 )
                 if batch is not None
                 else []
             )
-            if not updates and not sub.progress:
-                sub.frontier = ts + 1
+            if not updates and not ch.wants_progress():
+                ch.frontier = ts + 1
                 continue
-            if sub.publish(updates, progress_ts=(ts + 1) if sub.progress else None):
-                sub.frontier = ts + 1
-            else:
-                # shed (queue overflow) or closed under us: release the read
-                # hold now; the frontend reports 53400 on its next drain
+            entry = ch.publish(ts, updates, progress_ts=ts + 1)
+            ch.frontier = ts + 1
+            for sub in ch.shared_tick(entry):
+                # shed (backlog/retention) or closed under us: release the
+                # read hold now; the frontend reports 53400 on its next
+                # drain
                 if sub.state == "shed":
                     self.overload.bump("subscribe_sheds")
-                self.teardown_subscription(sub_id, state=sub.state)
+                self.teardown_subscription(sub.sub_id, state=sub.state)
+        # reclaim ring entries every live cursor is past (hard-capped by
+        # fanout_ring_ticks), then wake the reactor's stream pumps once
+        self.fanout.trim()
+        self.fanout.notify()
         if not self.sinks:
             return
         emit_durable = persist and self.durable and self.deploy_state == "leader"
@@ -1856,8 +1900,12 @@ class Coordinator:
         if window <= 0:
             return
         since = ts - window
-        for sub in self.subscriptions.values():
-            since = min(since, sub.frontier - 1)
+        # subscription read holds live on the CHANNELS (one hold per
+        # collection × columns, advanced once per tick, seeded at subscribe
+        # time — every coordinator-created subscription carries a channel),
+        # so this scan is O(channels + sinks), never O(subscribers)
+        for ch in self.fanout.live():
+            since = min(since, ch.frontier - 1)
         for sink in self.sinks.values():
             # sink read hold: commit-first re-derivation needs source shard
             # history back to the last committed frame's frontier

@@ -202,6 +202,23 @@ SUBSCRIBE_QUEUE_DEPTH = Config(
     "is shed with 53400 (SubscriptionOverflow) and the subscription torn "
     "down — bounds how much history one stalled reader can pin (0 = off)",
 )
+MAX_SUBSCRIPTIONS_PER_USER = Config(
+    "max_subscriptions_per_user",
+    0,
+    "live SUBSCRIBEs one user may hold concurrently; the overflow SUBSCRIBE "
+    "is refused at admission with a retryable 53300 so one tenant cannot "
+    "exhaust the fan-out ring's cursor table (0 = off); the user is the "
+    "pgwire startup-packet user / the HTTP request's user field",
+)
+FANOUT_RING_TICKS = Config(
+    "fanout_ring_ticks",
+    4096,
+    "frame entries (collection ticks) the shared egress fan-out ring retains "
+    "for lagging cursors; a subscriber that falls off the window is shed "
+    "with 53400 exactly like a queue overflow — this caps pinned history "
+    "per collection instead of per subscriber (0 = trim only to the "
+    "slowest live cursor)",
+)
 SINK_COMMIT_ORDER = Config(
     "sink_commit_order",
     "emit-first",
@@ -261,6 +278,28 @@ KERNEL_BACKEND = Config(
     "differential testing); takes effect at the next tick render, no restart",
 )
 
+# -- frontend backend (serve/: reactor vs thread-per-connection serving) -----
+FRONTEND_BACKEND = Config(
+    "frontend_backend",
+    "auto",
+    "which serving plane hosts the pgwire/HTTP frontends: 'reactor' runs a "
+    "single-threaded readiness-driven event loop (serve/reactor.py: "
+    "nonblocking sockets, per-connection state machines, shared-frame "
+    "SUBSCRIBE fan-out pumped straight from the egress ring), 'thread' "
+    "forces the historical thread-per-connection accept loops for "
+    "bisection, 'auto' picks the reactor; consulted at listener start "
+    "(serve_pgwire / http serve), not per connection — wire bytes are "
+    "identical either way (differential-tested in tests/test_serve.py)",
+)
+REACTOR_EXECUTOR_THREADS = Config(
+    "reactor_executor_threads",
+    8,
+    "worker threads the serve/ reactor hands blocking work to (statement "
+    "execution behind the admission gates, subscription teardown): the "
+    "event loop itself never blocks on the coordinator lock, so a stalled "
+    "command can delay command REPLIES but never readiness handling",
+)
+
 # -- exchange backend (parallel/devicemesh/: on-chip vs host shard exchange) -
 EXCHANGE_BACKEND = Config(
     "exchange_backend",
@@ -286,6 +325,10 @@ ALL_CONFIGS = [
     COORD_QUEUE_DEPTH,
     PEEK_QUEUE_DEPTH,
     SUBSCRIBE_QUEUE_DEPTH,
+    MAX_SUBSCRIPTIONS_PER_USER,
+    FANOUT_RING_TICKS,
+    FRONTEND_BACKEND,
+    REACTOR_EXECUTOR_THREADS,
     SINK_COMMIT_ORDER,
     SOURCE_INGEST_BUDGET,
     ENABLE_DELTA_JOIN,
@@ -328,6 +371,10 @@ class SessionConfigs:
         self.system = system
         self.overrides: dict = {}
         self.cancelled = threading.Event()
+        # authenticated identity (pgwire startup packet's `user` parameter /
+        # the HTTP request's user field): per-tenant admission budgets
+        # (max_subscriptions_per_user) charge against this name
+        self.user = "anonymous"
         # query-receipt timestamp stamped by the protocol layer: the
         # statement_timeout window opens HERE, so admission-queue wait
         # counts against the budget (consumed by Coordinator.execute_stmt)

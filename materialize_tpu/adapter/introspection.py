@@ -104,6 +104,10 @@ INTROSPECTION_TABLES = {
         ("delivered", ColType.INT64),
         ("shed_count", ColType.INT64),
         ("frontier", ColType.INT64),
+        # appended (not inserted) so positional consumers of the original
+        # seven columns keep working: the tenant charged by
+        # max_subscriptions_per_user
+        ("mz_user", ColType.STRING),
     ),
     "mz_sinks": _desc(
         ("id", ColType.STRING),
@@ -263,7 +267,7 @@ def introspection_rows(coord, name: str) -> list[tuple]:
         return [
             (
                 sid, sub.object_name, sub.state, sub.queue_depth(),
-                sub.delivered, sub.shed_count, sub.frontier,
+                sub.delivered, sub.shed_count, sub.frontier, sub.user,
             )
             for sid, sub in sorted(coord.subscriptions.items())
         ]

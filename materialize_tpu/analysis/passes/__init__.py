@@ -8,6 +8,7 @@ from .hygiene import ListenerHygiene
 from .kernels_rule import KernelDispatchCoherence
 from .metrics_rule import MetricsCoherence
 from .races import LockDiscipline
+from .reactor_rule import ReactorDiscipline
 from .registry_rules import CtpCoherence, DyncfgCoherence, SqlstateCoherence
 from .tracer import TracedCoercion, TracedNpCall, TracedSearchsorted
 
@@ -27,6 +28,7 @@ ALL_RULES = [
     KernelDispatchCoherence(),
     CollectiveCoherence(),
     MetricsCoherence(),
+    ReactorDiscipline(),
 ]
 
 RULES_BY_ID = {r.id: r for r in ALL_RULES}

@@ -11,6 +11,13 @@ socket.timeout`` (the periodic wake-up), and (3) handle ``except
 OSError`` (the closed-listener shutdown path). Files using stdlib servers
 (serve_forever is selector-driven) contain no literal ``.accept(`` and
 pass automatically.
+
+Nonblocking readiness loops (the serve/ reactor) are exempt: an
+``accept()`` on a listener that was ``setblocking(False)``-ed never
+blocks — it raises ``BlockingIOError`` when the backlog is empty — so the
+stuck-thread hazard this rule exists for cannot occur. A file qualifies
+for the exemption only when it shows both halves of that idiom:
+``setblocking(False)`` and a ``BlockingIOError`` handler.
 """
 
 from __future__ import annotations
@@ -28,6 +35,8 @@ def problems_for_text(text: str) -> list[str]:
     """The missing-needle descriptions for one file's source text."""
     if ".accept(" not in text:
         return []
+    if "setblocking(False)" in text and "BlockingIOError" in text:
+        return []  # nonblocking readiness loop — accept() cannot block
     return [
         f"accept loop lacks {what} ({needle!r})"
         for what, needle in REQUIRED.items()

@@ -34,6 +34,10 @@ REQUIRED_FAMILIES = (
     "mzt_device_exchange_programs_total",
     "mzt_device_exchange_mesh_devices",
     "mzt_device_exchange_retries_total",
+    # encode-once fan-out: the delivered/encoded ratio is the whole point
+    # of the shared frame ring, so both legs must stay observable
+    "mzt_egress_frames_encoded_total",
+    "mzt_egress_frames_delivered_total",
 )
 
 _BUMP = re.compile(r'(?:\.bump|\.record_max)\(\s*"([a-z_]+)"')

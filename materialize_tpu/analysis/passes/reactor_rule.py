@@ -1,0 +1,175 @@
+"""reactor-discipline: serve/ callback bodies must never block the loop.
+
+The serving plane (materialize_tpu/serve/) is a single-threaded readiness
+reactor: every registered callback runs on THE loop thread, so one
+blocking call — a `sendall` that waits for a slow peer, a `recv` issued
+without readiness, a sleep, or an acquisition of a shared command lock —
+stalls every connection at once. The reference gets this discipline from
+tokio's cooperative scheduler; in plain Python it is a convention, so
+this pass makes it a lint:
+
+  * no `.sendall(...)` — egress goes through the staged out-queue and
+    nonblocking `send` under EVENT_WRITE readiness;
+  * no `time.sleep` / `.sleep(...)` — deadlines are reactor timers;
+  * `.accept` / `.recv` / `.recv_into` / `.connect` only inside readiness
+    handlers (functions whose name contains "readable"), where the socket
+    is known ready and nonblocking;
+  * every function that accepts or creates a listening socket must set it
+    nonblocking (`setblocking(False)`) before registration, and
+    `setblocking(True)` is banned outright;
+  * no `with <...lock...>:` / `.acquire()` on lock-named attributes — the
+    coordinator command lock (and anything named like a lock) may only be
+    taken on the executor pool via `reactor.submit`. Short loop-internal
+    critical sections use the `*_mutex` naming convention, which this
+    pass deliberately exempts: a `_mutex` guards reactor bookkeeping for
+    nanoseconds; a `lock` serializes command execution for milliseconds.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted, is_lockish_name, terminal_name
+from ..core import Finding, Project, Rule, SourceFile
+
+#: socket reads that are only legitimate under readiness
+READINESS_METHODS = {"accept", "recv", "recv_into", "connect"}
+#: outright banned in serve/ regardless of context
+BANNED_METHODS = {"sendall", "sleep"}
+BANNED_DOTTED = {"time.sleep", "socket.create_connection"}
+
+SCOPE_DIR = "materialize_tpu/serve/"
+
+
+def _is_setblocking(call: ast.Call, value: bool) -> bool:
+    return (
+        isinstance(call.func, ast.Attribute)
+        and call.func.attr == "setblocking"
+        and len(call.args) == 1
+        and isinstance(call.args[0], ast.Constant)
+        and call.args[0].value is value
+    )
+
+
+class _FnScan(ast.NodeVisitor):
+    """One function body (nested defs are their own scopes)."""
+
+    def __init__(self, rule_id: str, rel: str, fn_name: str):
+        self.rule_id = rule_id
+        self.rel = rel
+        self.fn_name = fn_name
+        self.is_readiness = "readable" in fn_name
+        self.accepts_or_listens = False
+        self.sets_nonblocking = False
+        self.first_sock_line = 0
+        self.findings: list[Finding] = []
+
+    def _flag(self, node: ast.AST, msg: str) -> None:
+        self.findings.append(Finding(self.rule_id, self.rel, node.lineno, msg))
+
+    def visit_Call(self, node: ast.Call):
+        d = dotted(node.func)
+        term = terminal_name(node.func)
+        if d in BANNED_DOTTED or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in BANNED_METHODS
+        ):
+            self._flag(
+                node,
+                f"blocking call '{d or term}' on the reactor thread — "
+                "stage bytes for nonblocking send / use a reactor timer",
+            )
+        elif _is_setblocking(node, True):
+            self._flag(
+                node,
+                "setblocking(True) in serve/: every reactor socket stays "
+                "nonblocking for its whole life",
+            )
+        elif _is_setblocking(node, False):
+            self.sets_nonblocking = True
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in READINESS_METHODS
+        ):
+            if not self.is_readiness:
+                self._flag(
+                    node,
+                    f"socket '{node.func.attr}' outside a readiness "
+                    "handler (function name must contain 'readable') — "
+                    "reads belong to EVENT_READ callbacks",
+                )
+            if node.func.attr == "accept":
+                self.accepts_or_listens = True
+                self.first_sock_line = self.first_sock_line or node.lineno
+        elif term == "create_server":
+            self.accepts_or_listens = True
+            self.first_sock_line = self.first_sock_line or node.lineno
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+            and is_lockish_name(terminal_name(node.func.value))
+        ):
+            self._flag(
+                node,
+                f"'{terminal_name(node.func.value)}.acquire()' on the "
+                "reactor thread — shared locks are taken on the executor "
+                "(reactor.submit), never in a callback",
+            )
+        self.generic_visit(node)
+
+    def visit_With(self, node: ast.With):
+        for item in node.items:
+            expr = item.context_expr
+            if isinstance(expr, ast.Call):
+                continue
+            name = terminal_name(expr)
+            if is_lockish_name(name):
+                self._flag(
+                    node,
+                    f"'with {name}:' on the reactor thread — shared locks "
+                    "are taken on the executor (reactor.submit), never in "
+                    "a callback",
+                )
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        pass  # nested defs are scanned as their own scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass  # deferred bodies run via call_soon/submit, scanned lexically
+        # by the enclosing module walk anyway when written as defs
+
+    def finish(self):
+        if self.accepts_or_listens and not self.sets_nonblocking:
+            self._flag_line(
+                self.first_sock_line,
+                f"'{self.fn_name}' obtains a socket but never calls "
+                "setblocking(False) — nonblocking at registration is the "
+                "reactor contract",
+            )
+        return self.findings
+
+    def _flag_line(self, line: int, msg: str) -> None:
+        self.findings.append(Finding(self.rule_id, self.rel, line, msg))
+
+
+class ReactorDiscipline(Rule):
+    id = "reactor-discipline"
+    description = (
+        "serve/ callbacks never block: no sendall/sleep, readiness-gated "
+        "recv/accept, nonblocking sockets, no shared-lock acquisition on "
+        "the loop"
+    )
+
+    def scope(self, rel: str) -> bool:
+        return rel.startswith(SCOPE_DIR)
+
+    def check_file(self, sf: SourceFile, project: Project):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scan = _FnScan(self.id, sf.rel, node.name)
+                for stmt in node.body:
+                    scan.visit(stmt)
+                yield from scan.finish()

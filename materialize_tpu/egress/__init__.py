@@ -1,14 +1,20 @@
 """Egress plane: push-based SUBSCRIBE and exactly-once file sinks.
 
 The outbound half the serving stack was missing (reference:
-src/compute/src/sink/{subscribe,materialized_view}.rs). Two shapes:
+src/compute/src/sink/{subscribe,materialized_view}.rs). Three pieces:
 
-- `Subscription` (subscribe.py): a per-client bounded queue fed by the
-  coordinator at every commit tick with the collection's consolidated
-  update triples, drained by pgwire (COPY out stream) or the HTTP server
-  (chunked NDJSON / poll). Slow consumers are shed with the overload
-  taxonomy (errors.py: 53400 on queue overflow, 57014 on cancel, 57P05 on
-  idle), and teardown releases the subscription's compaction read hold.
+- `FanoutTree` / `Channel` (fanout.py): ONE consolidated, immutable,
+  pre-encoded frame per (collection, tick, format), shared zero-copy by
+  every subscriber of that collection — fan-out cost is sublinear in
+  subscriber count (the broadcast dual of Tascade's reduction trees).
+
+- `Subscription` (subscribe.py): a per-client *cursor* over the shared
+  frame ring (plus a private snapshot preamble), fed by the coordinator at
+  every commit tick, drained by pgwire (COPY out stream), the HTTP server
+  (chunked NDJSON / poll), or the serve/ reactor. Slow consumers are shed
+  with the overload taxonomy (errors.py: 53400 on backlog overflow or
+  retention loss, 57014 on cancel, 57P05 on idle), and teardown releases
+  the subscription's compaction read hold.
 
 - `FileSink` (sink.py): a catalog object appending a view's per-tick
   changelog to a file through the interchange text encoders, with a durable
@@ -16,7 +22,11 @@ src/compute/src/sink/{subscribe,materialized_view}.rs). Two shapes:
   exactly-once — no dropped or doubled deltas.
 """
 
+from .fanout import Channel, FanoutTree, Frame, FrameEntry
 from .sink import FileSink, progress_shard_id
 from .subscribe import Subscription
 
-__all__ = ["Subscription", "FileSink", "progress_shard_id"]
+__all__ = [
+    "Subscription", "FileSink", "progress_shard_id",
+    "FanoutTree", "Channel", "Frame", "FrameEntry",
+]

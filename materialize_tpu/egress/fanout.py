@@ -1,0 +1,483 @@
+"""FanoutTree — sublinear SUBSCRIBE fan-out: one frame per (collection, tick).
+
+PR 14's egress plane gave every subscriber a private queue holding its own
+copy of every update — fan-out cost O(subscribers × frame bytes) per tick.
+This module is the broadcast dual of Tascade's asynchronous reduction trees
+(PAPERS.md): the coordinator's `_egress_tick` publishes ONE consolidated,
+immutable `FrameEntry` per (collection, tick) into a shared per-collection
+`Channel`, and every subscriber holds a *cursor* (sequence number + offset)
+into the channel's ring instead of a queue copy. Wire encodings (pgwire COPY
+text, HTTP NDJSON) are computed lazily, exactly once per (entry, format),
+and cached on the entry — so delivering a tick to 10k subscribers costs 10k
+buffer references, not 10k encodes (`mzt_egress_frames_encoded_total` vs
+`mzt_egress_frames_delivered_total` makes the ratio observable).
+
+Retention: the ring is trimmed to the slowest *live* cursor, hard-capped at
+`fanout_ring_ticks` entries. A cursor that falls off the retained window is
+shed with the same documented 53400 contract as a queue overflow — bounded
+memory is the contract, only the bookkeeping changed (doc/SERVING.md).
+
+Threading: producers (the coordinator, under the command lock) append under
+the channel mutex; consumers (frontend threads / the serve reactor) read
+entries under the same mutex but NEVER copy update payloads — entries are
+immutable after publish, so a reference is safe outside the mutex. Lock
+order is subscription-cv → channel-mutex everywhere: consumers follow it in
+their read paths, and the producer's rare depth sweep follows it too
+(`shared_tick` drops the channel mutex before the per-cursor walk).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import threading
+from collections import deque
+
+from ..obs import metrics as obs_metrics
+
+# one sample per (entry, format) encode vs one per frame handed to a
+# subscriber: the encoded/delivered ratio is the satellite's observability
+# contract — O(ticks) encodes serving O(subscribers × ticks) deliveries
+_ENCODED = obs_metrics.REGISTRY.counter(
+    "mzt_egress_frames_encoded_total",
+    "frame encodes performed, by wire format (once per collection × tick "
+    "× format, plus per-subscriber snapshot preambles)",
+    labels=("format",),
+)
+_DELIVERED = obs_metrics.REGISTRY.counter(
+    "mzt_egress_frames_delivered_total",
+    "pre-encoded frames handed to subscriber connections, by wire format",
+    labels=("format",),
+)
+_UPDATES = obs_metrics.REGISTRY.counter(
+    "mzt_egress_subscribe_updates_total",
+    "update triples enqueued across all subscription queues",
+)
+
+# ring length at which trim() first pays for an exact slowest-cursor scan;
+# the threshold doubles while cursors lag so the scan stays amortized
+_TRIM_SCAN_MIN = 16
+
+
+def _copy_value(v) -> str:
+    """One COPY-text value — must render exactly like pgwire's historical
+    `_send_copy_row` so the frame bytes are indistinguishable from the
+    per-row `sendall` path they replaced."""
+    if v is None:
+        return "\\N"
+    if isinstance(v, bool):
+        return "t" if v else "f"
+    return str(v)
+
+
+def _copy_msg(payload: bytes) -> bytes:
+    # pgwire CopyData framing; must match frontend/pgwire.py `_msg(b"d", …)`
+    return b"d" + struct.pack(">I", len(payload) + 4) + payload
+
+
+def _json_default(v):
+    import numpy as np
+
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    raise TypeError(f"not serializable: {type(v)}")
+
+
+def encode_pgcopy(msgs, columns) -> bytes:
+    """COPY-out CopyData bytes for `[(ts, progressed, diff, row)]` — the
+    concatenation is stream-identical to sending one CopyData per row."""
+    ncols = len(columns)
+    out = []
+    for ts, progressed, diff, row in msgs:
+        vals = [str(ts), "t" if progressed else "f", str(diff)]
+        if row is None:  # progress rows carry no data columns
+            vals += ["\\N"] * ncols
+        else:
+            vals += [_copy_value(v) for v in row]
+        out.append(_copy_msg(("\t".join(vals) + "\n").encode()))
+    return b"".join(out)
+
+
+def encode_ndjson(msgs, columns) -> bytes:
+    """NDJSON lines for `[(ts, progressed, diff, row)]` — key order and
+    serialization must match the HTTP frontend's historical per-message
+    `json.dumps` so de-chunked stream bytes are unchanged."""
+    out = []
+    for ts, progressed, diff, row in msgs:
+        out.append(
+            json.dumps(
+                {
+                    "mz_timestamp": ts,
+                    "mz_progressed": progressed,
+                    "mz_diff": diff,
+                    "row": list(row) if row is not None else None,
+                },
+                default=_json_default,
+            ).encode()
+            + b"\n"
+        )
+    return b"".join(out)
+
+
+ENCODERS = {"pgcopy": encode_pgcopy, "ndjson": encode_ndjson}
+
+
+class Frame:
+    """One pre-encoded delivery unit handed to a frontend: `data` is ready
+    for the wire (CopyData messages / NDJSON lines), `count` is how many
+    logical messages it carries (the frontend's `delivered` accounting)."""
+
+    __slots__ = ("data", "count")
+
+    def __init__(self, data: bytes, count: int):
+        self.data = data
+        self.count = count
+
+
+class FrameEntry:
+    """One collection-tick: consolidated decoded updates plus the tick's
+    progress marker. Immutable after publish; per-format encodings are
+    cached here (under the owning channel's mutex) so they happen once.
+
+    `cum_updates` / `cum_progress` are running totals over the channel's
+    whole history INCLUDING this entry — cursors compute their backlog in
+    O(1) from the difference of two totals, never by walking the ring.
+    """
+
+    __slots__ = (
+        "seq", "ts", "updates", "progress_ts",
+        "cum_updates", "cum_progress", "_enc", "encode_count", "_columns",
+    )
+
+    def __init__(self, seq, ts, updates, progress_ts, cum_updates, cum_progress,
+                 columns=()):
+        self.seq = seq
+        self.ts = ts
+        self.updates = updates  # tuple of (ts, False, diff, row) messages
+        self.progress_ts = progress_ts
+        self.cum_updates = cum_updates
+        self.cum_progress = cum_progress
+        self._enc: dict = {}  # (format, part) -> bytes
+        self.encode_count = 0  # test hook: encodes performed on this entry
+        self._columns = tuple(columns)
+
+    def encoded(self, fmt: str, part: str) -> bytes:
+        """Cached encode of this entry's `part` ('data' = update rows,
+        'progress' = the progress marker line). Caller holds the channel
+        mutex; the encode-once contract is this cache."""
+        key = (fmt, part)
+        data = self._enc.get(key)
+        if data is None:
+            if part == "data":
+                msgs = self.updates
+            else:
+                msgs = ((self.progress_ts, True, 0, None),)
+            data = ENCODERS[fmt](msgs, self._columns)
+            self._enc[key] = data
+            self.encode_count += 1
+            _ENCODED.inc(1, format=fmt)
+        return data
+
+
+class Channel:
+    """Per-(collection, columns) epoch-tagged ring of immutable frames.
+
+    `base_seq` is the sequence number of the oldest retained entry; entries
+    below it have been reclaimed. `trim()` drops everything every live
+    cursor has consumed, hard-capped at `retention` entries — a cursor left
+    below `base_seq` has provably lost data and must shed (53400).
+    """
+
+    def __init__(self, key, gid: str, columns: tuple, tree: "FanoutTree | None" = None):
+        self._mutex = threading.Lock()
+        self.key = key
+        self.gid = gid
+        self.columns = tuple(columns)
+        self.tree = tree
+        self.base_seq = 0
+        self.next_seq = 0
+        # totals over reclaimed history (entries below base_seq)
+        self.base_cum_updates = 0
+        self.base_cum_progress = 0
+        self.entries: deque = deque()
+        self.cursors: set = set()  # live Subscription cursors
+        self.pq = None  # row-decode schema, pinned by the coordinator
+        # produced-through frontier: updates with time < frontier have been
+        # published into the ring (or were provably absent this tick) —
+        # _drive_compaction holds `since` below it, one hold per CHANNEL
+        # rather than one per subscriber
+        self.frontier = 0
+        # consumers park on ONE condition per channel (wait_for_tick); the
+        # producer notifies it once per tick instead of walking every
+        # subscriber's private cv
+        self.wait_cv = threading.Condition()
+        self._progress_cursors = 0
+        self._depth_counts: dict = {}  # max_depth -> cursor count (bounded only)
+        # conservative lower bound on the laggiest cursor's effective
+        # position (updates + progress markers, positionally) — see
+        # shared_tick for the sweep-amortization argument
+        self._floor = 0
+        self._lag_pending = False  # trim() left a live cursor behind the base
+        self._scan_at = _TRIM_SCAN_MIN  # ring length triggering the next scan
+
+    # -- producer (coordinator tick, under the command lock) ------------------
+    def publish(self, ts: int, updates: list, progress_ts: int | None) -> FrameEntry:
+        msgs = tuple(
+            (int(t), False, int(d), row) for t, d, row in updates
+        )
+        with self._mutex:
+            cum_u = self._head_cum_updates_locked() + len(msgs)
+            cum_p = self._head_cum_progress_locked() + (
+                1 if progress_ts is not None else 0
+            )
+            entry = FrameEntry(
+                self.next_seq, int(ts), msgs, progress_ts, cum_u, cum_p,
+                columns=self.columns,
+            )
+            self.next_seq += 1
+            self.entries.append(entry)
+        return entry
+
+    def trim(self, retention: int) -> None:
+        """Reclaim ring entries. The retention cap (`fanout_ring_ticks`) is
+        applied every tick in O(popped); the exact trim-to-slowest-cursor
+        scan is O(cursors), so it only runs once the ring has grown past a
+        doubling threshold — amortized sublinear per tick, bounding the
+        ring at roughly 2x what the laggiest live cursor pins (and always
+        at the cap). A cursor the cap leaves behind discovers the loss on
+        its next read, or in the next depth sweep, and sheds (53400)."""
+        with self._mutex:
+            floor = self.next_seq - retention if retention > 0 else self.base_seq
+            scanned = len(self.entries) >= self._scan_at
+            if scanned:
+                live = [
+                    s._seq for s in self.cursors if s.state == "active"
+                ]
+                slowest = min(live) if live else self.next_seq
+                if slowest < floor:
+                    # the cap just moved the base past a live cursor: force
+                    # the exact sweep on the next tick so it shed-tears
+                    # down promptly instead of idling until its next read
+                    self._lag_pending = True
+                else:
+                    floor = slowest
+            while self.entries and self.entries[0].seq < floor:
+                e = self.entries.popleft()
+                self.base_cum_updates = e.cum_updates
+                self.base_cum_progress = e.cum_progress
+                self.base_seq = e.seq + 1
+            if scanned:
+                self._scan_at = max(_TRIM_SCAN_MIN, 2 * len(self.entries))
+
+    # -- cursor bookkeeping ----------------------------------------------------
+    def register(self, sub) -> int:
+        """Attach a cursor at the channel head (it sees ticks from now on);
+        returns the starting sequence number. A new cursor starts caught-up,
+        so the laggiest-cursor floor stays a valid lower bound untouched."""
+        with self._mutex:
+            self.cursors.add(sub)
+            if sub.progress:
+                self._progress_cursors += 1
+            if sub.max_depth > 0:
+                self._depth_counts[sub.max_depth] = (
+                    self._depth_counts.get(sub.max_depth, 0) + 1
+                )
+            return self.next_seq
+
+    def unregister(self, sub) -> None:
+        with self._mutex:
+            if sub in self.cursors:
+                self.cursors.discard(sub)
+                if sub.progress:
+                    self._progress_cursors -= 1
+                if sub.max_depth > 0:
+                    c = self._depth_counts.get(sub.max_depth, 0) - 1
+                    if c > 0:
+                        self._depth_counts[sub.max_depth] = c
+                    else:
+                        self._depth_counts.pop(sub.max_depth, None)
+            empty = not self.cursors
+        if empty and self.tree is not None:
+            self.tree._reap(self)
+
+    def wants_progress(self) -> bool:
+        """Whether any live cursor asked for PROGRESS markers (quiet ticks
+        must still publish an entry for those)."""
+        return self._progress_cursors > 0
+
+    # -- per-tick cursor accounting (the sublinear fast path) ------------------
+    def shared_tick(self, entry: FrameEntry) -> list:
+        """Account one just-published entry against every cursor, O(1) in
+        the cursor count on the common path.
+
+        The exact per-cursor backlog check costs a lock round-trip per
+        subscriber — doing it every tick is exactly what made the tick wall
+        O(subscribers). Instead the channel keeps `_floor`, a lower bound on
+        the effective position of its laggiest cursor. Any cursor's backlog
+        is at most `head - _floor`, so while that stays within the tightest
+        registered `max_depth` no cursor CAN be over its bound and the tick
+        does constant work. Only when the bound is threatened — or `trim()`
+        left a live cursor behind the ring base — does the exact O(cursors)
+        sweep run, shedding violators and re-tightening the floor; sweeps
+        therefore amortize to once per `min(max_depth)` published messages.
+
+        Returns the cursors that must be torn down ([] almost always).
+        """
+        with self._mutex:
+            n = len(self.cursors)
+            if n == 0:
+                return []
+            head_eff = entry.cum_updates + entry.cum_progress
+            min_depth = min(self._depth_counts) if self._depth_counts else 0
+            sweep = self._lag_pending or (
+                min_depth > 0 and head_eff - self._floor > min_depth
+            )
+            cursors = list(self.cursors) if sweep else None
+        if entry.updates:
+            _UPDATES.inc(len(entry.updates) * n)
+        if cursors is None:
+            return []
+        doomed, floor = [], head_eff
+        for sub in cursors:
+            keep, eff = sub.shared_tick_exact(entry)
+            if keep:
+                floor = min(floor, eff)
+            else:
+                doomed.append(sub)
+        with self._mutex:
+            self._floor = floor
+            self._lag_pending = False
+        return doomed
+
+    # -- consumer parking (one condition per channel, not per subscriber) ------
+    def wait_for_tick(self, seq: int, timeout: float) -> None:
+        """Park until an entry past `seq` exists (or `timeout`). The
+        producer bumps `next_seq` before notifying, so the head check here
+        cannot miss a tick that landed before the caller got the cv."""
+        with self.wait_cv:
+            if self.next_seq > seq:
+                return
+            self.wait_cv.wait(timeout)
+
+    def notify_waiters(self) -> None:
+        with self.wait_cv:
+            self.wait_cv.notify_all()
+
+    # -- consumer reads (any frontend thread / the reactor) --------------------
+    def entry_at(self, seq: int):
+        """The retained entry at `seq`, or 'behind' when it fell off the
+        ring, or None at/past the head (nothing new yet)."""
+        with self._mutex:
+            if seq < self.base_seq:
+                return "behind"
+            idx = seq - self.base_seq
+            if idx >= len(self.entries):
+                return None
+            return self.entries[idx]
+
+    def cum_before(self, seq: int) -> tuple:
+        """(updates, progress) totals over history strictly before `seq`."""
+        with self._mutex:
+            if seq <= self.base_seq:
+                return self.base_cum_updates, self.base_cum_progress
+            idx = seq - self.base_seq - 1
+            if idx >= len(self.entries):
+                return (
+                    self._head_cum_updates_locked(),
+                    self._head_cum_progress_locked(),
+                )
+            e = self.entries[idx]
+            return e.cum_updates, e.cum_progress
+
+    def head_totals(self) -> tuple:
+        with self._mutex:
+            return (
+                self._head_cum_updates_locked(),
+                self._head_cum_progress_locked(),
+            )
+
+    def encoded(self, entry: FrameEntry, fmt: str, part: str) -> bytes:
+        with self._mutex:
+            return entry.encoded(fmt, part)
+
+    def _head_cum_updates_locked(self) -> int:
+        return self.entries[-1].cum_updates if self.entries else self.base_cum_updates
+
+    def _head_cum_progress_locked(self) -> int:
+        return (
+            self.entries[-1].cum_progress if self.entries else self.base_cum_progress
+        )
+
+
+class FanoutTree:
+    """All live channels plus the reactor wake fan-out.
+
+    The coordinator owns one tree; `_egress_tick` publishes into it and then
+    calls `notify()` ONCE — the serve reactor's wakeup pipes fire and each
+    channel's consumer condition is notified (threaded frontends park there,
+    one cv per channel), so everyone pumps whatever their cursors can now
+    see at O(channels + listeners) producer cost. `retention()` reads
+    the `fanout_ring_ticks` dyncfg at trim time, so ALTER SYSTEM takes
+    effect on the next tick."""
+
+    def __init__(self, retention=None):
+        self._mutex = threading.Lock()
+        self.channels: dict = {}
+        self.retention = retention or (lambda: 0)
+        self._listeners: list = []
+
+    def channel(self, gid: str, columns: tuple) -> Channel:
+        key = (gid, tuple(columns))
+        with self._mutex:
+            ch = self.channels.get(key)
+            if ch is None:
+                ch = Channel(key, gid, tuple(columns), tree=self)
+                self.channels[key] = ch
+            return ch
+
+    def trim(self) -> None:
+        retention = int(self.retention())
+        with self._mutex:
+            chans = list(self.channels.values())
+        for ch in chans:
+            ch.trim(retention)
+
+    def _reap(self, ch: Channel) -> None:
+        """Drop a channel whose last cursor detached (ad-hoc SUBSCRIBEs get
+        a fresh hidden-MV gid each, so the dict would otherwise grow without
+        bound)."""
+        with self._mutex:
+            if ch.key in self.channels and not ch.cursors:
+                del self.channels[ch.key]
+
+    # -- reactor wakeups -------------------------------------------------------
+    def add_listener(self, cb) -> None:
+        with self._mutex:
+            self._listeners.append(cb)
+
+    def remove_listener(self, cb) -> None:
+        with self._mutex:
+            try:
+                self._listeners.remove(cb)
+            except ValueError:
+                pass
+
+    def live(self) -> list:
+        """Snapshot of the live channels (the coordinator's tick loop and
+        compaction driver iterate channels, never subscribers)."""
+        with self._mutex:
+            return list(self.channels.values())
+
+    def notify(self) -> None:
+        with self._mutex:
+            chans = list(self.channels.values())
+            listeners = list(self._listeners)
+        for ch in chans:
+            ch.notify_waiters()
+        for cb in listeners:
+            try:
+                cb()
+            except Exception:
+                pass

@@ -9,13 +9,15 @@ ErrorResponse severity/code fields; adapter errors carry SqlState):
 
     57014  query_canceled            — statement_timeout fired, or a pgwire
                                        CancelRequest with the right secret
-    53300  too_many_connections     — max_connections / admission-gate shed;
-                                       RETRYABLE: the queue was full, not the
-                                       statement wrong
+    53300  too_many_connections     — max_connections / admission-gate shed,
+                                       or max_subscriptions_per_user refused a
+                                       SUBSCRIBE at admission; RETRYABLE: the
+                                       queue was full, not the statement wrong
     53400  configuration_limit_exceeded — result would exceed max_result_size,
                                        or a SUBSCRIBE client fell further than
-                                       subscribe_queue_depth ticks behind and
-                                       was shed
+                                       subscribe_queue_depth messages behind
+                                       (or off the fanout_ring_ticks retention
+                                       window) and was shed
     57P05  idle_session_timeout     — idle_in_transaction_session_timeout
                                        closed the connection (including a
                                        SUBSCRIBE that delivered nothing and
@@ -54,6 +56,16 @@ class AdmissionShed(SqlError):
 
 class TooManyConnections(SqlError):
     """max_connections exceeded at accept time (53300, retryable)."""
+
+    sqlstate = "53300"
+    retryable = True
+
+
+class TooManySubscriptions(SqlError):
+    """max_subscriptions_per_user exceeded at SUBSCRIBE admission: one
+    tenant may not exhaust the fan-out ring's cursor table (53300,
+    retryable — the same "resource line is full, come back" contract as
+    the admission gates)."""
 
     sqlstate = "53300"
     retryable = True

@@ -63,74 +63,34 @@ class SqlHandler(BaseHTTPRequestHandler):
         return json.loads(raw)
 
     def do_GET(self):
-        if self.path == "/api/readyz":
-            return self._reply(200, "ok", "text/plain")
-        if self.path == "/metrics":
-            return self._reply(200, self._metrics_text(), "text/plain")
-        if self.path.startswith("/prof/cpu"):
-            from urllib.parse import parse_qs, urlparse
-
-            from ..utils.prof import cpu_profile_folded
-
-            seconds = 1.0
-            qs = parse_qs(urlparse(self.path).query)
-            if "seconds" in qs:
-                try:
-                    seconds = min(float(qs["seconds"][0]), 30.0)
-                except ValueError:
-                    pass
-            return self._reply(200, cpu_profile_folded(seconds), "text/plain")
-        if self.path.startswith("/prof/heap"):
-            from ..utils.prof import heap_profile_text
-
-            return self._reply(200, heap_profile_text(), "text/plain")
-        if self.path.startswith("/api/subscribe/") and self.path.endswith("/poll"):
-            from ..errors import SqlError
-
-            sub_id = self.path.split("/")[3]
-            with self.lock:
-                try:
-                    rows, frontier = self.coordinator.poll_subscription(sub_id)
-                except KeyError:
-                    return self._reply(404, {"error": f"unknown subscription {sub_id}"})
-                except SqlError as e:  # shed (53400): report once, tear down
-                    self.coordinator.teardown_subscription(sub_id)
-                    return self._reply(
-                        400, {"error": str(e), "code": e.sqlstate}
-                    )
-            updates = [
-                {"row": list(data), "timestamp": ts, "diff": d} for data, ts, d in rows
-            ]
-            return self._reply(200, {"updates": updates, "frontier": frontier})
         if self.path.startswith("/api/subscribe/") and self.path.endswith("/stream"):
             return self._stream_subscription(self.path.split("/")[3])
-        return self._reply(404, {"error": "not found"})
+        code, body, ctype = route(self.coordinator, self.lock, "GET", self.path, b"")
+        return self._reply(code, body, ctype)
 
     def _stream_subscription(self, sub_id: str):
         """Push SUBSCRIBE over HTTP: chunked NDJSON, one object per update
         `{"mz_timestamp":…,"mz_progressed":…,"mz_diff":…,"row":[…]}`,
         streamed until the collection is dropped, the client disconnects,
-        the bounded queue sheds the subscription (terminal line with
-        code 53400), or the idle timeout reaps it (terminal line with
-        code 57P05). The queue drain happens WITHOUT the coordinator lock."""
+        the subscription is shed (terminal line with code 53400), or the
+        idle timeout reaps it (terminal line with code 57P05). One chunk
+        per pre-encoded FRAME from the shared fan-out ring — the bytes are
+        rendered once per (collection, tick), not per subscriber — and the
+        drain happens WITHOUT the coordinator lock."""
         from ..errors import IdleTimeout, SqlError
 
-        with self.lock:
-            sub = self.coordinator.subscriptions.get(sub_id)
-            idle_ms = int(
-                self.coordinator.configs.get("idle_in_transaction_session_timeout")
-            )
-        if sub is None:
+        found = stream_prelude(self.coordinator, self.lock, sub_id)
+        if found is None:
             return self._reply(404, {"error": f"unknown subscription {sub_id}"})
+        sub, idle_ms = found
         self.send_response(200)
         self.send_header("content-type", "application/x-ndjson")
         self.send_header("transfer-encoding", "chunked")
         self.end_headers()
 
-        def chunk(line: str) -> bool:
-            data = (line + "\n").encode()
+        def chunk(data: bytes) -> bool:
             try:
-                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.write(http_chunk(data))
                 self.wfile.flush()
                 return True
             except OSError:
@@ -140,11 +100,11 @@ class SqlHandler(BaseHTTPRequestHandler):
         try:
             while True:
                 try:
-                    msg = sub.pop(timeout=0.25)
+                    frame = sub.pop_frame("ndjson", timeout=0.25)
                 except SqlError as e:
-                    chunk(json.dumps({"error": str(e), "code": e.sqlstate}))
+                    chunk(stream_error_line(e))
                     break
-                if msg is None:
+                if frame is None:
                     if sub.state != "active":
                         break  # dropped: end the stream cleanly
                     if (
@@ -156,23 +116,11 @@ class SqlHandler(BaseHTTPRequestHandler):
                             "terminating SUBSCRIBE stream due to "
                             "idle-in-transaction session timeout"
                         )
-                        chunk(json.dumps({"error": str(err), "code": err.sqlstate}))
+                        chunk(stream_error_line(err))
                         break
                     continue
-                ts, progressed, diff, row = msg
                 last_delivery = time.monotonic()
-                ok = chunk(
-                    json.dumps(
-                        {
-                            "mz_timestamp": ts,
-                            "mz_progressed": progressed,
-                            "mz_diff": diff,
-                            "row": list(row) if row is not None else None,
-                        },
-                        default=_json_default,
-                    )
-                )
-                if not ok:
+                if not chunk(frame.data):
                     break  # client went away: tear down below
         finally:
             with self.lock:
@@ -185,61 +133,174 @@ class SqlHandler(BaseHTTPRequestHandler):
         self.close_connection = True
 
     def do_POST(self):
-        if self.path == "/api/sql":
-            from ..errors import AdmissionShed, sqlstate_of
-
-            try:
-                doc = self._read_body()
-                sql = doc.get("query", "")
-                # same admission discipline as pgwire — literally the same
-                # implementation (adapter/overload.py `admitted`): the
-                # coordinator's waiting line is bounded across EVERY
-                # frontend; a shed returns 503 + retryable code instead of
-                # queuing forever
-                from ..adapter.overload import admitted
-
-                with admitted(self.coordinator, sql, self.lock):
-                    results = self.coordinator.execute_script(sql)
-                out = []
-                for r in results:
-                    if r.kind == "rows":
-                        out.append(
-                            {
-                                "rows": [list(row) for row in r.rows],
-                                "col_names": list(r.columns),
-                            }
-                        )
-                    elif r.kind == "copy":
-                        out.append(
-                            {"copy": getattr(r, "copy_data", ""), "ok": r.status}
-                        )
-                    else:
-                        out.append({"ok": r.status})
-                return self._reply(200, {"results": out})
-            except Exception as e:
-                code = 503 if isinstance(e, AdmissionShed) else 400
-                return self._reply(
-                    code, {"error": str(e), "code": sqlstate_of(e)}
-                )
-        if self.path == "/api/promote":
-            try:
-                with self.lock:
-                    self.coordinator.promote()
-                return self._reply(200, {"state": self.coordinator.deploy_state})
-            except Exception as e:
-                return self._reply(400, {"error": str(e)})
-        if self.path == "/api/subscribe":
-            try:
-                doc = self._read_body()
-                with self.lock:
-                    r = self.coordinator.execute(doc.get("query", ""))
-                return self._reply(200, {"subscription_id": r.status})
-            except Exception as e:
-                return self._reply(400, {"error": str(e)})
-        return self._reply(404, {"error": "not found"})
+        n = int(self.headers.get("content-length", 0))
+        raw = self.rfile.read(n) if n else b"{}"
+        code, body, ctype = route(self.coordinator, self.lock, "POST", self.path, raw)
+        return self._reply(code, body, ctype)
 
     def _metrics_text(self) -> str:
         return metrics_text(self.coordinator, self.lock)
+
+
+def route(coord, lock, method: str, path: str, raw: bytes):
+    """One non-streaming request → `(status, body, content_type)`.
+
+    Shared verbatim by BOTH serving backends — the ThreadingHTTPServer
+    handler above and the serve/ reactor's connection pump — so route
+    behavior (status codes, error envelopes, admission discipline) cannot
+    drift between them. The two chunked-streaming endpoints are the only
+    paths handled by the callers themselves."""
+    if method == "GET":
+        if path == "/api/readyz":
+            return 200, "ok", "text/plain"
+        if path == "/metrics":
+            return 200, metrics_text(coord, lock), "text/plain"
+        if path.startswith("/prof/cpu"):
+            from urllib.parse import parse_qs, urlparse
+
+            from ..utils.prof import cpu_profile_folded
+
+            seconds = 1.0
+            qs = parse_qs(urlparse(path).query)
+            if "seconds" in qs:
+                try:
+                    seconds = min(float(qs["seconds"][0]), 30.0)
+                except ValueError:
+                    pass
+            return 200, cpu_profile_folded(seconds), "text/plain"
+        if path.startswith("/prof/heap"):
+            from ..utils.prof import heap_profile_text
+
+            return 200, heap_profile_text(), "text/plain"
+        if path.startswith("/api/subscribe/") and path.endswith("/poll"):
+            from ..errors import SqlError
+
+            sub_id = path.split("/")[3]
+            with lock:
+                try:
+                    rows, frontier = coord.poll_subscription(sub_id)
+                except KeyError:
+                    return (
+                        404,
+                        {"error": f"unknown subscription {sub_id}"},
+                        "application/json",
+                    )
+                except SqlError as e:  # shed (53400): report once, tear down
+                    coord.teardown_subscription(sub_id)
+                    return (
+                        400,
+                        {"error": str(e), "code": e.sqlstate},
+                        "application/json",
+                    )
+            updates = [
+                {"row": list(data), "timestamp": ts, "diff": d}
+                for data, ts, d in rows
+            ]
+            return (
+                200,
+                {"updates": updates, "frontier": frontier},
+                "application/json",
+            )
+        return 404, {"error": "not found"}, "application/json"
+    if path == "/api/sql":
+        from ..errors import AdmissionShed, sqlstate_of
+
+        try:
+            doc = json.loads(raw or b"{}")
+            sql = doc.get("query", "")
+            # same admission discipline as pgwire — literally the same
+            # implementation (adapter/overload.py `admitted`): the
+            # coordinator's waiting line is bounded across EVERY
+            # frontend; a shed returns 503 + retryable code instead of
+            # queuing forever
+            from ..adapter.overload import admitted
+
+            with admitted(coord, sql, lock):
+                results = coord.execute_script(sql)
+            out = []
+            for r in results:
+                if r.kind == "rows":
+                    out.append(
+                        {
+                            "rows": [list(row) for row in r.rows],
+                            "col_names": list(r.columns),
+                        }
+                    )
+                elif r.kind == "copy":
+                    out.append(
+                        {"copy": getattr(r, "copy_data", ""), "ok": r.status}
+                    )
+                else:
+                    out.append({"ok": r.status})
+            return 200, {"results": out}, "application/json"
+        except Exception as e:
+            code = 503 if isinstance(e, AdmissionShed) else 400
+            return (
+                code,
+                {"error": str(e), "code": sqlstate_of(e)},
+                "application/json",
+            )
+    if path == "/api/promote":
+        try:
+            with lock:
+                coord.promote()
+            return 200, {"state": coord.deploy_state}, "application/json"
+        except Exception as e:
+            return 400, {"error": str(e)}, "application/json"
+    if path == "/api/subscribe":
+        try:
+            doc = json.loads(raw or b"{}")
+            with lock:
+                session = None
+                if doc.get("user"):
+                    # tenant identity for max_subscriptions_per_user budgets
+                    # (pgwire clients carry it in the startup packet)
+                    session = coord.new_session()
+                    session.user = str(doc["user"])
+                r = coord.execute(doc.get("query", ""), session)
+            return 200, {"subscription_id": r.status}, "application/json"
+        except Exception as e:
+            from ..errors import sqlstate_of
+
+            err = {"error": str(e), "code": sqlstate_of(e)}
+            # retryable sheds (53300: max_subscriptions_per_user, admission)
+            # get 503 like /api/sql, so generic clients back off and retry
+            status = 503 if getattr(e, "retryable", False) else 400
+            return status, err, "application/json"
+    return 404, {"error": "not found"}, "application/json"
+
+
+def stream_prelude(coord, lock, sub_id: str):
+    """Look up a subscription + idle budget for a /stream request (both
+    backends); None means 404."""
+    with lock:
+        sub = coord.subscriptions.get(sub_id)
+        idle_ms = int(
+            coord.configs.get("idle_in_transaction_session_timeout")
+        )
+    if sub is None:
+        return None
+    return sub, idle_ms
+
+
+def teardown(coord, lock, sub_id: str) -> None:
+    """Tear a subscription down under the command lock — the stream-end
+    path of both serving backends (the reactor runs this on its executor
+    pool; callbacks on the loop never take the lock)."""
+    with lock:
+        coord.teardown_subscription(sub_id)
+
+
+def http_chunk(data: bytes) -> bytes:
+    """One HTTP/1.1 transfer-encoding chunk. Both backends emit one chunk
+    per frame, so the raw chunked stream (not merely the de-chunked body)
+    is byte-identical between them."""
+    return f"{len(data):x}\r\n".encode() + data + b"\r\n"
+
+
+def stream_error_line(e) -> bytes:
+    """Terminal NDJSON error line for a shed/idle/cancelled stream."""
+    return (json.dumps({"error": str(e), "code": e.sqlstate}) + "\n").encode()
 
 
 def metrics_text(coord, lock) -> str:
@@ -373,14 +434,29 @@ def metrics_text(coord, lock) -> str:
 
 
 def serve(
-    coordinator: Coordinator, host: str = "127.0.0.1", port: int = 6875
-) -> ThreadingHTTPServer:
+    coordinator: Coordinator,
+    host: str = "127.0.0.1",
+    port: int = 6875,
+    lock: threading.Lock | None = None,
+    backend: str | None = None,
+    reactor=None,
+):
     """Start the HTTP frontend (returns the server; call serve_forever or
-    shutdown from the caller)."""
+    shutdown from the caller — both backends expose that surface, plus
+    `server_address` and `RequestHandlerClass.lock`). The serving plane is
+    picked by `backend` / the frontend_backend dyncfg; pass `reactor` to
+    share one event loop with the pgwire frontend."""
+    from .pgwire import resolve_frontend_backend
+
+    lock = lock or threading.Lock()
+    if resolve_frontend_backend(coordinator, backend) == "reactor":
+        from ..serve import serve_http_reactor
+
+        return serve_http_reactor(coordinator, host, port, lock, reactor=reactor)
     handler = type(
         "BoundSqlHandler",
         (SqlHandler,),
-        {"coordinator": coordinator, "lock": threading.Lock()},
+        {"coordinator": coordinator, "lock": lock},
     )
     httpd = ThreadingHTTPServer((host, port), handler)
     return httpd

@@ -103,12 +103,27 @@ def _has_bare_semicolon(sql: str) -> bool:
 
 
 class PgConnection:
-    def __init__(self, sock: socket.socket, coordinator: Coordinator, lock,
-                 server: "PgServer | None" = None):
+    """Per-connection pgwire protocol state machine.
+
+    Transport-agnostic by construction: every outbound byte goes through
+    `_send` (sendall on the owned socket), and the two blocking entry points
+    — `run()`'s read loop and `_stream_subscription`'s inline drain — are
+    only used by the threaded backend. The serve/ reactor drives the SAME
+    state machine by feeding `_startup_packet`/`dispatch` with frames it
+    framed itself and giving `sock` a buffering shim, so the bytes any
+    client sees are identical across backends by construction.
+    """
+
+    def __init__(self, sock, coordinator: Coordinator, lock,
+                 server=None):
         self.sock = sock
         self.coord = coordinator
         self.lock = lock
         self.server = server
+        # threaded mode streams SUBSCRIBE inline (blocking drain); the
+        # reactor flips this off and pumps `pending_stream` from the ring
+        self.stream_inline = True
+        self.pending_stream: dict | None = None
         self.session = coordinator.new_session()
         # cancellation identity (BackendKeyData): a CancelRequest must quote
         # this exact (pid, secret) pair; anything else is a silent no-op
@@ -153,43 +168,10 @@ class PgConnection:
                         first_byte_timeout=idle_ms / 1000.0 if idle_ms > 0 else None
                     )
                 except socket.timeout:
-                    self.coord.overload.bump("idle_timeouts")
-                    err = IdleTimeout(
-                        "terminating connection due to "
-                        "idle-in-transaction session timeout"
-                    )
-                    self._send_error(err.sqlstate, str(err))
+                    self._send_idle_timeout_error()
                     break
-                if tag is None or tag == b"X":
+                if not self.dispatch(tag, payload):
                     break
-                if tag == b"Q":
-                    sql = payload[:-1].decode()
-                    self._simple_query(sql)
-                elif tag == b"S":  # Sync: clear error state, drop portals
-                    self.in_error = False
-                    self.portals.clear()
-                    self._send_ready()
-                elif tag == b"H":  # Flush
-                    pass
-                elif tag in (b"P", b"B", b"D", b"E", b"C"):
-                    if self.in_error:
-                        continue  # discard until Sync, per spec
-                    try:
-                        handler = {
-                            b"P": self._handle_parse,
-                            b"B": self._handle_bind,
-                            b"D": self._handle_describe,
-                            b"E": self._handle_execute,
-                            b"C": self._handle_close,
-                        }[tag]
-                        handler(payload)
-                    except (ConnectionError, OSError):
-                        raise
-                    except Exception as e:  # malformed payloads etc.
-                        self._ext_error("08P01", f"protocol error: {e}")
-                else:
-                    self._send_error("08P01", f"unexpected message {tag!r}")
-                    self._send_ready()
         except (ConnectionError, OSError):
             pass
         finally:
@@ -200,6 +182,50 @@ class PgConnection:
                 self.sock.close()
             except OSError:
                 pass
+
+    def dispatch(self, tag, payload) -> bool:
+        """Process ONE framed protocol message; returns False when the
+        connection should close (EOF or Terminate). Both backends call this
+        — the threaded run() loop above, the reactor per readable frame."""
+        if tag is None or tag == b"X":
+            return False
+        if tag == b"Q":
+            sql = payload[:-1].decode()
+            self._simple_query(sql)
+        elif tag == b"S":  # Sync: clear error state, drop portals
+            self.in_error = False
+            self.portals.clear()
+            self._send_ready()
+        elif tag == b"H":  # Flush
+            pass
+        elif tag in (b"P", b"B", b"D", b"E", b"C"):
+            if self.in_error:
+                return True  # discard until Sync, per spec
+            try:
+                handler = {
+                    b"P": self._handle_parse,
+                    b"B": self._handle_bind,
+                    b"D": self._handle_describe,
+                    b"E": self._handle_execute,
+                    b"C": self._handle_close,
+                }[tag]
+                handler(payload)
+            except (ConnectionError, OSError):
+                raise
+            except Exception as e:  # malformed payloads etc.
+                self._ext_error("08P01", f"protocol error: {e}")
+        else:
+            self._send_error("08P01", f"unexpected message {tag!r}")
+            self._send_ready()
+        return True
+
+    def _send_idle_timeout_error(self) -> None:
+        self.coord.overload.bump("idle_timeouts")
+        err = IdleTimeout(
+            "terminating connection due to "
+            "idle-in-transaction session timeout"
+        )
+        self._send_error(err.sqlstate, str(err))
 
     def _saturated(self) -> bool:
         """max_connections admission: this connection counts itself."""
@@ -233,29 +259,48 @@ class PgConnection:
             body = self._read_exact(n - 4)
             if body is None:
                 return False
-            (code,) = struct.unpack(">I", body[:4])
-            if code == _CANCEL_REQUEST:
-                # processed even at max_connections: a saturated server that
-                # refuses cancels could never be relieved by its own clients
-                self._handle_cancel_request(body)
-                return False
-            if self._saturated():
-                # shed at the first request/response exchange, so the
-                # balancer's round-trip probe (SSLRequest → expects 'N')
-                # sees saturation, not health; retryable by contract
-                self.coord.overload.bump("connections_rejected")
-                err = TooManyConnections("too many connections; retry later")
-                self._send_error(err.sqlstate, str(err))
-                return False
-            if code in (_SSL_REQUEST, _GSSENC_REQUEST):
-                self.sock.sendall(b"N")  # no TLS; client retries cleartext
+            verdict = self._startup_packet(body)
+            if verdict == "more":
                 continue
-            if code != _PROTO_V3:
-                self._send_error("08P01", f"unsupported protocol {code}")
-                return False
-            # params: key\0value\0...\0 — accepted, unused for now
-            break
-        self.sock.sendall(_msg(b"R", struct.pack(">I", 0)))  # AuthenticationOk
+            return verdict == "ready"
+
+    def _startup_packet(self, body: bytes) -> str:
+        """One length-prefixed startup-phase packet: 'more' (SSL/GSS probe
+        answered, keep reading), 'ready' (handshake complete), or 'close'."""
+        (code,) = struct.unpack(">I", body[:4])
+        if code == _CANCEL_REQUEST:
+            # processed even at max_connections: a saturated server that
+            # refuses cancels could never be relieved by its own clients
+            self._handle_cancel_request(body)
+            return "close"
+        if self._saturated():
+            # shed at the first request/response exchange, so the
+            # balancer's round-trip probe (SSLRequest → expects 'N')
+            # sees saturation, not health; retryable by contract
+            self.coord.overload.bump("connections_rejected")
+            err = TooManyConnections("too many connections; retry later")
+            self._send_error(err.sqlstate, str(err))
+            return "close"
+        if code in (_SSL_REQUEST, _GSSENC_REQUEST):
+            self._send(b"N")  # no TLS; client retries cleartext
+            return "more"
+        if code != _PROTO_V3:
+            self._send_error("08P01", f"unsupported protocol {code}")
+            return "close"
+        self._parse_startup_params(body[4:])
+        self._send_startup_ok()
+        return "ready"
+
+    def _parse_startup_params(self, body: bytes) -> None:
+        """key\\0value\\0…\\0: the `user` parameter becomes the session's
+        tenant identity (max_subscriptions_per_user budgets)."""
+        parts = body.split(b"\x00")
+        for i in range(0, len(parts) - 1, 2):
+            if parts[i] == b"user" and parts[i + 1]:
+                self.session.user = parts[i + 1].decode(errors="replace")
+
+    def _send_startup_ok(self) -> None:
+        self._send(_msg(b"R", struct.pack(">I", 0)))  # AuthenticationOk
         for k, v in (
             ("server_version", "9.5.0 materialize_tpu"),
             ("client_encoding", "UTF8"),
@@ -263,12 +308,17 @@ class PgConnection:
             ("integer_datetimes", "on"),
             ("standard_conforming_strings", "on"),
         ):
-            self.sock.sendall(_msg(b"S", _cstr(k) + _cstr(v)))
+            self._send(_msg(b"S", _cstr(k) + _cstr(v)))
         # BackendKeyData: the (pid, secret) a client must echo to cancel
-        self.sock.sendall(_msg(b"K", struct.pack(">II", self.pid, self.secret)))
-        return True
+        self._send(_msg(b"K", struct.pack(">II", self.pid, self.secret)))
 
     # -- messages --------------------------------------------------------------
+    def _send(self, data: bytes) -> None:
+        """Single egress seam: the threaded backend writes through to the
+        socket; the reactor's sock shim buffers into the connection's
+        outbuf instead."""
+        self.sock.sendall(data)
+
     def _read_exact(self, n: int):
         buf = b""
         while len(buf) < n:
@@ -296,16 +346,16 @@ class PgConnection:
         return tag, payload
 
     def _send_ready(self) -> None:
-        self.sock.sendall(_msg(b"Z", b"I"))
+        self._send(_msg(b"Z", b"I"))
 
     def _send_error(self, code: str, message: str) -> None:
         fields = b"S" + _cstr("ERROR") + b"C" + _cstr(code) + b"M" + _cstr(message) + b"\x00"
-        self.sock.sendall(_msg(b"E", fields))
+        self._send(_msg(b"E", fields))
 
     # -- queries ---------------------------------------------------------------
     def _simple_query(self, sql: str) -> None:
         if not sql.strip():
-            self.sock.sendall(_msg(b"I", b""))  # EmptyQueryResponse
+            self._send(_msg(b"I", b""))  # EmptyQueryResponse
             self._send_ready()
             return
         # a cancel targets THIS query message (which may be a whole script):
@@ -322,31 +372,50 @@ class PgConnection:
             self._send_ready()
             return
         self._send_results(results, with_description=True)
-        self._send_ready()
+        if self.pending_stream is not None:
+            # reactor mode: the stream pump owns the connection now; the
+            # ReadyForQuery rides behind the stream's terminal messages
+            self.pending_stream["send_ready"] = True
+        else:
+            self._send_ready()
 
     def _send_results(self, results, with_description: bool) -> None:
-        for r in results:
+        results = list(results)
+        for i, r in enumerate(results):
             if r.kind == "rows":
                 if with_description:
                     self._send_row_description(r)
                 for row in r.rows:
                     self._send_data_row(row)
-                self.sock.sendall(_msg(b"C", _cstr(f"SELECT {len(r.rows)}")))
+                self._send(_msg(b"C", _cstr(f"SELECT {len(r.rows)}")))
             elif r.kind == "subscribe":
-                self._stream_subscription(r)
+                if self.stream_inline:
+                    self._stream_subscription(r)
+                else:
+                    # reactor mode: emit the COPY header and hand the pump
+                    # the subscription + whatever results trail it (they go
+                    # out after the stream ends, as the inline path orders)
+                    self._send_copy_header(len(r.subscription.columns))
+                    self.pending_stream = {
+                        "sub": r.subscription,
+                        "rest": results[i + 1:],
+                        "with_description": with_description,
+                        "send_ready": False,
+                    }
+                    return
             elif r.kind == "copy":
                 # CopyOutResponse (text format), CopyData lines, CopyDone
                 ncols = len(r.columns)
-                self.sock.sendall(
+                self._send(
                     _msg(b"H", b"\x00" + struct.pack(">H", ncols) + b"\x00\x00" * ncols)
                 )
                 data = getattr(r, "copy_data", "")
                 if data:
-                    self.sock.sendall(_msg(b"d", data.encode()))
-                self.sock.sendall(_msg(b"c", b""))
-                self.sock.sendall(_msg(b"C", _cstr(r.status)))
+                    self._send(_msg(b"d", data.encode()))
+                self._send(_msg(b"c", b""))
+                self._send(_msg(b"C", _cstr(r.status)))
             else:
-                self.sock.sendall(_msg(b"C", _cstr(r.status)))
+                self._send(_msg(b"C", _cstr(r.status)))
 
     # -- SUBSCRIBE streaming -----------------------------------------------------
     def _stream_subscription(self, r: ExecResult) -> None:
@@ -364,10 +433,7 @@ class PgConnection:
         from ..errors import QueryCanceled, SqlError
 
         sub = r.subscription
-        ncols = 3 + len(sub.columns)
-        self.sock.sendall(
-            _msg(b"H", b"\x00" + struct.pack(">H", ncols) + b"\x00\x00" * ncols)
-        )
+        self._send_copy_header(len(sub.columns))
         idle_ms = int(self.session.get("idle_in_transaction_session_timeout"))
         last_activity = time.monotonic()
         delivered = 0
@@ -388,11 +454,13 @@ class PgConnection:
                         self._teardown_sub(sub, "cancelled")
                         return  # connection dropped; run() sees EOF next read
                     break
-                msg = sub.pop(timeout=0.05)
-                if msg is not None:
-                    ts, progressed, diff, row = msg
-                    self._send_copy_row(ts, progressed, diff, row, sub.columns)
-                    delivered += 1
+                # one pre-encoded frame per tick from the shared fan-out
+                # ring (egress/fanout.py): the bytes were rendered once per
+                # (collection, tick), not per subscriber
+                frame = sub.pop_frame("pgcopy", timeout=0.05)
+                if frame is not None:
+                    self._send(frame.data)
+                    delivered += frame.count
                     last_activity = time.monotonic()
                     continue
                 if sub.state != "active":
@@ -410,26 +478,22 @@ class PgConnection:
             self._send_error(e.sqlstate, str(e))
             return
         self._teardown_sub(sub, "cancelled")
-        self.sock.sendall(_msg(b"c", b""))
-        self.sock.sendall(_msg(b"C", _cstr(f"SUBSCRIBE {delivered}")))
+        self._send(_msg(b"c", b""))
+        self._send(_msg(b"C", _cstr(f"SUBSCRIBE {delivered}")))
 
     def _teardown_sub(self, sub, state: str) -> None:
         with self.lock:
             self.coord.teardown_subscription(sub.sub_id, state=state)
 
-    def _send_copy_row(self, ts, progressed, diff, row, columns) -> None:
-        vals = [str(ts), "t" if progressed else "f", str(diff)]
-        if row is None:  # progress rows carry no data columns
-            vals += ["\\N"] * len(columns)
-        else:
-            for v in row:
-                if v is None:
-                    vals.append("\\N")
-                elif isinstance(v, bool):
-                    vals.append("t" if v else "f")
-                else:
-                    vals.append(str(v))
-        self.sock.sendall(_msg(b"d", ("\t".join(vals) + "\n").encode()))
+    def _send_copy_header(self, data_columns: int) -> None:
+        """CopyOutResponse for a SUBSCRIBE stream: text format, the three
+        mz_* columns plus the collection's data columns. Row bytes are
+        rendered by egress/fanout.py `encode_pgcopy` — one encode per
+        (collection, tick), shared by every subscriber."""
+        ncols = 3 + data_columns
+        self._send(
+            _msg(b"H", b"\x00" + struct.pack(">H", ncols) + b"\x00\x00" * ncols)
+        )
 
     # -- extended query protocol ------------------------------------------------
     def _ext_error(self, code: str, message: str) -> None:
@@ -453,7 +517,7 @@ class PgConnection:
             self._ext_error("42601", "multiple statements not allowed in Parse")
             return
         self.statements[name] = sql
-        self.sock.sendall(_msg(b"1", b""))  # ParseComplete
+        self._send(_msg(b"1", b""))  # ParseComplete
 
     def _handle_bind(self, payload: bytes) -> None:
         portal, off = self._read_cstr(payload, 0)
@@ -491,7 +555,7 @@ class PgConnection:
                 self._ext_error("08P01", f"parameter ${idx} not bound")
                 return
         self.portals[portal] = (sql, tuple(params))
-        self.sock.sendall(_msg(b"2", b""))  # BindComplete
+        self._send(_msg(b"2", b""))  # BindComplete
 
     def _describe_columns(self, sql: str, params=None):
         """Column (name, oid) pairs for a statement, or None for no result set."""
@@ -524,7 +588,7 @@ class PgConnection:
         payload = struct.pack(">H", len(cols))
         for name, oid in cols:
             payload += _cstr(name) + struct.pack(">IhIhih", 0, 0, oid, -1, -1, 0)
-        self.sock.sendall(_msg(b"T", payload))
+        self._send(_msg(b"T", payload))
 
     def _handle_describe(self, payload: bytes) -> None:
         kind = payload[0:1]
@@ -535,7 +599,7 @@ class PgConnection:
                 self._ext_error("26000", f"unknown prepared statement {name!r}")
                 return
             n_params = len({idx for _s, _e, idx in _scan_params(sql)})
-            self.sock.sendall(
+            self._send(
                 _msg(b"t", struct.pack(">H", n_params) + struct.pack(">I", _OID_TEXT) * n_params)
             )
             params = None
@@ -553,7 +617,7 @@ class PgConnection:
         if cols:
             self._send_description(cols)
         else:
-            self.sock.sendall(_msg(b"n", b""))  # NoData
+            self._send(_msg(b"n", b""))  # NoData
 
     def _handle_execute(self, payload: bytes) -> None:
         portal, off = self._read_cstr(payload, 0)
@@ -581,7 +645,7 @@ class PgConnection:
             self.statements.pop(name, None)
         else:
             self.portals.pop(name, None)
-        self.sock.sendall(_msg(b"3", b""))  # CloseComplete
+        self._send(_msg(b"3", b""))  # CloseComplete
 
     def _send_row_description(self, r: ExecResult) -> None:
         payload = struct.pack(">H", len(r.columns))
@@ -599,7 +663,7 @@ class PgConnection:
                 _cstr(name or f"column{i+1}")
                 + struct.pack(">IhIhih", 0, 0, oid, -1, -1, 0)
             )
-        self.sock.sendall(_msg(b"T", payload))
+        self._send(_msg(b"T", payload))
 
     def _send_data_row(self, row: tuple) -> None:
         payload = struct.pack(">H", len(row))
@@ -613,7 +677,7 @@ class PgConnection:
                 text = str(v)
             data = text.encode()
             payload += struct.pack(">I", len(data)) + data
-        self.sock.sendall(_msg(b"D", payload))
+        self._send(_msg(b"D", payload))
 
 
 class PgServer:
@@ -683,13 +747,38 @@ class PgServer:
                     pass
 
 
+def resolve_frontend_backend(coordinator, backend: str | None = None) -> str:
+    """'thread' or 'reactor' from an explicit override or the
+    `frontend_backend` dyncfg ('auto' picks the reactor — the serving plane
+    built for fan-out; 'thread' keeps the historical accept loops for
+    bisection)."""
+    mode = backend or str(coordinator.configs.get("frontend_backend"))
+    if mode == "auto":
+        mode = "reactor"
+    if mode not in ("thread", "reactor"):
+        raise ValueError(f"unknown frontend_backend {mode!r}")
+    return mode
+
+
 def serve_pgwire(
     coordinator: Coordinator,
     host: str = "127.0.0.1",
     port: int = 6877,
     lock: threading.Lock | None = None,
+    backend: str | None = None,
+    reactor=None,
 ):
     """Start the pgwire listener; returns (server, accept thread). The
-    server exposes getsockname()/close() like the raw socket it used to be."""
-    server = PgServer(coordinator, host, port, lock or threading.Lock())
+    server exposes getsockname()/close() like the raw socket it used to be.
+    The serving plane is picked by `backend` / the frontend_backend dyncfg;
+    pass `reactor` to share one event loop across frontends."""
+    lock = lock or threading.Lock()
+    if resolve_frontend_backend(coordinator, backend) == "reactor":
+        from ..serve import serve_pgwire_reactor
+
+        server = serve_pgwire_reactor(
+            coordinator, host, port, lock, reactor=reactor
+        )
+        return server, server.thread
+    server = PgServer(coordinator, host, port, lock)
     return server, server.thread

@@ -1,0 +1,30 @@
+"""serve/ — the async reactor serving plane.
+
+One selectors-based event loop (reactor.py) hosts both wire frontends —
+pgwire (pgserve.py) and HTTP (httpserve.py) — replacing thread-per-
+connection accept loops: per-connection state machines on nonblocking
+sockets, commands shipped to a small executor pool (the coordinator
+command path stays threaded behind the AdmissionGates), and SUBSCRIBE
+fan-out pumped from the shared frame ring (egress/fanout.py) so a tick's
+bytes are encoded once and referenced per subscriber.
+
+The threaded frontends remain available behind the `frontend_backend`
+dyncfg (thread | reactor | auto) for bisection; both planes drive the
+same protocol state machines, so their wire output is byte-identical
+(differential-tested in tests/test_serve.py). Discipline for code in
+this package — no blocking calls in reactor callbacks, sockets
+nonblocking at registration — is enforced by the mzlint
+`reactor-discipline` pass.
+"""
+
+from .httpserve import ReactorHttpServer, serve_http_reactor
+from .pgserve import ReactorPgServer, serve_pgwire_reactor
+from .reactor import Reactor
+
+__all__ = [
+    "Reactor",
+    "ReactorPgServer",
+    "ReactorHttpServer",
+    "serve_pgwire_reactor",
+    "serve_http_reactor",
+]

@@ -1,0 +1,471 @@
+"""Reactor-hosted HTTP frontend: the event-loop twin of
+`frontend.http_server.serve`.
+
+Requests are parsed by the reactor (HTTP/1.1, keep-alive, content-length
+bodies) and answered through the SAME `route()` table the threaded
+handler uses — run on the executor pool, because every route takes the
+coordinator lock behind the admission gates. The two chunked-NDJSON
+SUBSCRIBE stream endpoints are pumped by the reactor from the shared
+fan-out ring, one chunk per pre-encoded frame, byte-identical to the
+threaded handler's chunk stream (`http_chunk` is shared).
+
+API-compatible with the `ThreadingHTTPServer` the threaded backend
+returns: `serve_forever()` / `shutdown()` / `server_address`, plus a
+`RequestHandlerClass` carrying the bound `coordinator`/`lock` attributes
+callers reach through (``__main__`` shares that lock with pgwire).
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..errors import IdleTimeout, SqlError
+from ..frontend.http_server import (
+    _json_default,
+    http_chunk,
+    route,
+    stream_error_line,
+    stream_prelude,
+    teardown,
+)
+from .reactor import EVENT_READ, EVENT_WRITE, Reactor
+
+HIGH_WATER = 256 * 1024
+SWEEP_S = 0.05
+_MAX_HEAD = 64 * 1024
+_MAX_BODY = 16 * 1024 * 1024
+
+_REASON = {
+    200: "OK", 400: "Bad Request", 404: "Not Found",
+    500: "Internal Server Error", 503: "Service Unavailable",
+}
+
+
+class _HttpConn:
+    __slots__ = (
+        "sock", "inbuf", "out", "out_off", "out_len", "phase", "eof",
+        "closing", "closed", "want_write", "close_after", "stream",
+    )
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.inbuf = bytearray()
+        self.out: list = []
+        self.out_off = 0
+        self.out_len = 0
+        self.phase = "idle"  # idle | busy | streaming
+        self.eof = False
+        self.closing = False
+        self.closed = False
+        self.want_write = False
+        self.close_after = False
+        self.stream: dict | None = None
+
+
+class ReactorHttpServer:
+    """HTTP listener on the reactor."""
+
+    def __init__(self, coordinator, host: str, port: int, lock,
+                 reactor: Reactor | None = None):
+        self.coord = coordinator
+        self.lock = lock
+        if reactor is None:
+            reactor = Reactor(
+                executor_threads=int(
+                    coordinator.configs.get("reactor_executor_threads")
+                )
+            )
+            self._owns_reactor = True
+        else:
+            self._owns_reactor = False
+        self.reactor = reactor
+        self.thread = reactor.thread
+        # the threaded server's handler-class surface, for callers that
+        # share the command lock or poke the bound coordinator
+        self.RequestHandlerClass = type(
+            "BoundReactorHandler", (),
+            {"coordinator": coordinator, "lock": lock},
+        )
+        self.conns: set = set()
+        self._closed = False
+        self._stopped = threading.Event()
+        self.srv = socket.create_server((host, port))
+        self.srv.listen(64)
+        self.srv.setblocking(False)
+        self.server_address = self.srv.getsockname()
+        self.reactor.in_loop(
+            lambda: self.reactor.register(
+                self.srv, EVENT_READ, self._listener_readable
+            )
+        )
+
+    # -- ThreadingHTTPServer-compatible surface --------------------------------
+    def serve_forever(self) -> None:
+        """Requests are served by the reactor regardless; this just parks
+        the calling thread until shutdown(), like the threaded server."""
+        self._stopped.wait()
+
+    def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        done = threading.Event()
+
+        def _do():
+            try:
+                self.reactor.unregister(self.srv)
+            except (KeyError, OSError, ValueError):
+                pass
+            try:
+                self.srv.close()
+            except OSError:
+                pass
+            for c in list(self.conns):
+                self._close_conn(c)
+            done.set()
+            if self._owns_reactor:
+                self.reactor.stop()
+
+        self.reactor.in_loop(_do)
+        done.wait(2.0)
+        self._stopped.set()
+        if self._owns_reactor:
+            self.reactor.thread.join(2.0)
+
+    def server_close(self) -> None:
+        self.shutdown()
+
+    # -- accept / readiness ----------------------------------------------------
+    def _listener_readable(self, sock, mask) -> None:
+        while True:
+            try:
+                conn, _addr = sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn.setblocking(False)
+            c = _HttpConn(conn)
+            self.conns.add(c)
+            self.reactor.register(
+                conn, EVENT_READ, lambda s, m, c=c: self._conn_event(c, m)
+            )
+
+    def _conn_event(self, c: _HttpConn, mask: int) -> None:
+        if mask & EVENT_READ:
+            self._conn_readable(c)
+        if not c.closed and (mask & EVENT_WRITE):
+            self._conn_writable(c)
+
+    def _conn_readable(self, c: _HttpConn) -> None:
+        while True:
+            try:
+                chunk = c.sock.recv(65536)
+            except BlockingIOError:
+                break
+            except OSError:
+                chunk = b""
+            if chunk == b"":
+                c.eof = True
+                break
+            c.inbuf += chunk
+        if not c.closed:
+            self._process(c)
+
+    def _conn_writable(self, c: _HttpConn) -> None:
+        while c.out:
+            head = c.out[0]
+            view = memoryview(head)[c.out_off:] if c.out_off else head
+            try:
+                n = c.sock.send(view)
+            except BlockingIOError:
+                break
+            except OSError:
+                self._close_conn(c)
+                return
+            if n <= 0:
+                break
+            c.out_off += n
+            c.out_len -= n
+            if c.out_off >= len(head):
+                c.out.pop(0)
+                c.out_off = 0
+        self._set_write_interest(c, bool(c.out))
+        if not c.out:
+            if c.closing:
+                self._close_conn(c)
+            elif c.stream is not None:
+                self._pump_stream(c)
+
+    def _set_write_interest(self, c: _HttpConn, want: bool) -> None:
+        if c.closed or want == c.want_write:
+            return
+        c.want_write = want
+        events = EVENT_READ | (EVENT_WRITE if want else 0)
+        try:
+            self.reactor.modify(
+                c.sock, events, lambda s, m, c=c: self._conn_event(c, m)
+            )
+        except (KeyError, OSError, ValueError):
+            pass
+
+    def _enqueue_out(self, c: _HttpConn, data: bytes) -> None:
+        if not data or c.closed:
+            return
+        c.out.append(data)
+        c.out_len += len(data)
+        self._conn_writable(c)
+
+    # -- request parsing -------------------------------------------------------
+    def _process(self, c: _HttpConn) -> None:
+        if c.phase == "streaming":
+            if c.eof:
+                self._end_stream(c, "eof")
+            else:
+                c.inbuf.clear()  # the threaded handler never reads mid-stream
+            return
+        if c.phase == "busy":
+            return  # reply in flight; pipelined input parses after it lands
+        idx = c.inbuf.find(b"\r\n\r\n")
+        if idx < 0:
+            if c.eof or len(c.inbuf) > _MAX_HEAD:
+                self._close_conn(c)
+            return
+        head = bytes(c.inbuf[:idx]).decode("latin-1", "replace")
+        lines = head.split("\r\n")
+        parts = lines[0].split(None, 2)
+        if len(parts) != 3:
+            self._close_conn(c)
+            return
+        method, path, version = parts
+        headers = {}
+        for ln in lines[1:]:
+            if ":" in ln:
+                k, v = ln.split(":", 1)
+                headers[k.strip().lower()] = v.strip()
+        try:
+            clen = int(headers.get("content-length", "0") or 0)
+        except ValueError:
+            self._close_conn(c)
+            return
+        if clen < 0 or clen > _MAX_BODY:
+            self._close_conn(c)
+            return
+        if len(c.inbuf) < idx + 4 + clen:
+            if c.eof:
+                self._close_conn(c)
+            return
+        body = bytes(c.inbuf[idx + 4 : idx + 4 + clen])
+        del c.inbuf[: idx + 4 + clen]
+        c.close_after = (
+            headers.get("connection", "").lower() == "close"
+            or version == "HTTP/1.0"
+        )
+        c.phase = "busy"
+        if (
+            method == "GET"
+            and path.startswith("/api/subscribe/")
+            and path.endswith("/stream")
+        ):
+            sub_id = path.split("/")[3]
+            self.reactor.submit(
+                lambda: stream_prelude(self.coord, self.lock, sub_id),
+                lambda res, exc, c=c: self._stream_prelude_done(c, res, exc),
+            )
+            return
+        self.reactor.submit(
+            lambda m=method, p=path, b=body: route(
+                self.coord, self.lock, m, p, b
+            ),
+            lambda res, exc, c=c: self._route_done(c, res, exc),
+        )
+
+    # -- plain replies ---------------------------------------------------------
+    def _route_done(self, c: _HttpConn, res, exc) -> None:
+        if c.closed:
+            return
+        if exc is not None:
+            res = (500, {"error": str(exc)}, "application/json")
+        code, body, ctype = res
+        self._reply(c, code, body, ctype)
+
+    def _reply(self, c: _HttpConn, code: int, body, ctype: str) -> None:
+        import json
+
+        data = (
+            body.encode()
+            if isinstance(body, str)
+            else json.dumps(body, default=_json_default).encode()
+        )
+        head = (
+            f"HTTP/1.1 {code} {_REASON.get(code, 'OK')}\r\n"
+            f"content-type: {ctype}\r\n"
+            f"content-length: {len(data)}\r\n"
+        )
+        if c.close_after:
+            head += "connection: close\r\n"
+        self._enqueue_out(c, head.encode() + b"\r\n" + data)
+        if c.closed:
+            return
+        if c.close_after:
+            self._start_close(c)
+            return
+        c.phase = "idle"
+        self._process(c)  # pipelined request already buffered?
+
+    # -- SUBSCRIBE streaming ---------------------------------------------------
+    def _stream_prelude_done(self, c: _HttpConn, found, exc) -> None:
+        if c.closed:
+            return
+        if exc is not None:
+            self._reply(c, 500, {"error": str(exc)}, "application/json")
+            return
+        if found is None:
+            self._reply(c, 404, {"error": "unknown subscription"},
+                        "application/json")
+            return
+        sub, idle_ms = found
+        self._enqueue_out(
+            c,
+            b"HTTP/1.1 200 OK\r\n"
+            b"content-type: application/x-ndjson\r\n"
+            b"transfer-encoding: chunked\r\n\r\n",
+        )
+        if c.closed:
+            return
+        c.phase = "streaming"
+        listener = lambda c=c: self.reactor.call_soon(  # noqa: E731
+            lambda: self._pump_stream(c)
+        )
+        c.stream = {
+            "sub": sub,
+            "idle_ms": idle_ms,
+            "last_activity": time.monotonic(),
+            "listener": listener,
+            "timer": None,
+            "ending": None,
+            "pumping": False,
+        }
+        self.coord.fanout.add_listener(listener)
+        self._stream_tick(c)
+
+    def _stream_tick(self, c: _HttpConn) -> None:
+        st = c.stream
+        if st is None or c.closed:
+            return
+        self._pump_stream(c)
+        st = c.stream
+        if st is not None and st["ending"] is None:
+            st["timer"] = self.reactor.call_later(
+                SWEEP_S, lambda c=c: self._stream_tick(c)
+            )
+
+    def _pump_stream(self, c: _HttpConn) -> None:
+        st = c.stream
+        if st is None or c.closed or st["ending"] is not None or st["pumping"]:
+            return
+        sub = st["sub"]
+        if c.eof:
+            self._end_stream(c, "eof")
+            return
+        drained = False
+        st["pumping"] = True
+        try:
+            while c.out_len < HIGH_WATER:
+                try:
+                    frame = sub.pop_frame("ndjson", timeout=0.0)
+                except SqlError as e:
+                    self._end_stream(c, e)
+                    return
+                if frame is None:
+                    drained = True
+                    break
+                st["last_activity"] = time.monotonic()
+                self._enqueue_out(c, http_chunk(frame.data))
+                if c.closed or c.stream is not st:
+                    return
+        finally:
+            st["pumping"] = False
+        if drained and sub.state != "active":
+            self._end_stream(c, "clean")
+            return
+        idle_ms = st["idle_ms"]
+        if (
+            idle_ms > 0
+            and (time.monotonic() - st["last_activity"]) > idle_ms / 1000.0
+        ):
+            self.coord.overload.bump("idle_timeouts")
+            self._end_stream(
+                c,
+                IdleTimeout(
+                    "terminating SUBSCRIBE stream due to "
+                    "idle-in-transaction session timeout"
+                ),
+            )
+
+    def _end_stream(self, c: _HttpConn, how) -> None:
+        st = c.stream
+        if st is None or st["ending"] is not None:
+            return
+        st["ending"] = how
+        self.coord.fanout.remove_listener(st["listener"])
+        if st["timer"] is not None:
+            st["timer"].cancel()
+            st["timer"] = None
+        sub = st["sub"]
+        if isinstance(how, SqlError):
+            # terminal NDJSON line precedes teardown in the byte stream,
+            # exactly as the threaded handler orders it
+            self._enqueue_out(c, http_chunk(stream_error_line(how)))
+        self.reactor.submit(
+            lambda s=sub.sub_id: teardown(self.coord, self.lock, s),
+            lambda res, exc, c=c: self._stream_torn_down(c, how),
+        )
+
+    def _stream_torn_down(self, c: _HttpConn, how) -> None:
+        c.stream = None
+        if c.closed:
+            return
+        if how != "eof":
+            self._enqueue_out(c, b"0\r\n\r\n")
+        # a finished stream always closes the connection (threaded:
+        # close_connection = True)
+        self._start_close(c)
+
+    # -- teardown --------------------------------------------------------------
+    def _start_close(self, c: _HttpConn) -> None:
+        if c.closed:
+            return
+        c.closing = True
+        if not c.out:
+            self._close_conn(c)
+
+    def _close_conn(self, c: _HttpConn) -> None:
+        if c.closed:
+            return
+        c.closed = True
+        st = c.stream
+        if st is not None:
+            self.coord.fanout.remove_listener(st["listener"])
+            if st["timer"] is not None:
+                st["timer"].cancel()
+            if st["ending"] is None:
+                self.reactor.submit(
+                    lambda s=st["sub"].sub_id: teardown(self.coord, self.lock, s),
+                    lambda res, exc: None,
+                )
+            c.stream = None
+        self.conns.discard(c)
+        try:
+            self.reactor.unregister(c.sock)
+        except (KeyError, OSError, ValueError):
+            pass
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+
+
+def serve_http_reactor(coordinator, host: str, port: int, lock,
+                       reactor: Reactor | None = None) -> ReactorHttpServer:
+    return ReactorHttpServer(coordinator, host, port, lock, reactor=reactor)

@@ -1,0 +1,578 @@
+"""Reactor-hosted pgwire: the event-loop twin of `frontend.pgwire.PgServer`.
+
+Byte-identity by construction: this module frames inbound traffic itself
+(startup packets, then tagged messages) and feeds the SAME
+`PgConnection` state machine the threaded backend runs — through
+`_startup_packet` / `dispatch` — with the connection's socket replaced by
+a staging shim whose `sendall` appends to a buffer. Whatever bytes the
+threaded path would have written, this path writes, in the same order;
+only the transport differs (nonblocking `send` with a pending out-queue
+instead of blocking `sendall`).
+
+Per-connection state machine:
+
+    STARTUP --(handshake ok)--> READY <--> BUSY --(SUBSCRIBE)--> STREAMING
+       |                          |           (one executor job at a time;
+       +--(cancel/refuse/EOF)--> CLOSING <----+  frames queue behind it)
+
+Commands run on the reactor's executor pool because they block on the
+coordinator lock behind the AdmissionGates (the command path stays
+threaded, per the tentpole). STREAMING is driven by the reactor itself:
+a FanoutTree listener plus a short sweep timer pump pre-encoded frames
+from the subscription cursor into the out-queue under a high-watermark,
+so one slow client buffers bounded bytes here and sheds (53400) at the
+ring, never stalling the loop or the coordinator.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+from ..errors import IdleTimeout, QueryCanceled, SqlError
+from ..frontend.pgwire import PgConnection, _cstr, _msg
+from .reactor import EVENT_READ, EVENT_WRITE, Reactor
+
+# streaming backpressure: stop pumping frames into a connection whose
+# unsent bytes exceed this; resume when the socket drains. The REAL bound
+# on a slow reader is the ring (subscribe_queue_depth / fanout_ring_ticks
+# → 53400) — this only caps reactor-side memory per connection.
+HIGH_WATER = 256 * 1024
+# streaming sweep cadence: cancel flags, idle budgets, and dropped
+# collections are observed at this granularity, matching the threaded
+# drain loop's 50 ms pop timeout
+SWEEP_S = 0.05
+_MAX_FRAME = 1 << 20  # startup/message length sanity bound
+
+
+class _StagedSock:
+    """Socket stand-in handed to PgConnection: `sendall` stages bytes for
+    the reactor to move into the connection's out-queue. Single-writer by
+    protocol — either the one in-flight executor job or the reactor
+    (startup phase / idle error), never both."""
+
+    __slots__ = ("staged",)
+
+    def __init__(self):
+        self.staged: list = []
+
+    def sendall(self, data) -> None:
+        self.staged.append(bytes(data))
+
+
+class _PgConn:
+    """Reactor-side bookkeeping for one pgwire connection."""
+
+    __slots__ = (
+        "sock", "pg", "shim", "inbuf", "out", "out_off", "out_len",
+        "phase", "frames", "job_running", "closing", "closed", "eof",
+        "want_write", "idle_timer", "startup_timer", "stream",
+    )
+
+    def __init__(self, sock, server):
+        self.sock = sock
+        self.shim = _StagedSock()
+        self.pg = PgConnection(self.shim, server.coord, server.lock,
+                               server=server)
+        self.pg.stream_inline = False  # SUBSCRIBE hands the pump a cursor
+        self.inbuf = bytearray()
+        self.out: list = []  # deque-of-chunks out-queue (head partially sent)
+        self.out_off = 0
+        self.out_len = 0
+        self.phase = "startup"
+        self.frames: list = []
+        self.job_running = False
+        self.closing = False
+        self.closed = False
+        self.eof = False
+        self.want_write = False
+        self.idle_timer = None
+        self.startup_timer = None
+        self.stream: dict | None = None
+
+
+class ReactorPgServer:
+    """pgwire listener on the reactor. API-compatible with the threaded
+    `PgServer`: `getsockname()`, `close()`, `active_connections`,
+    `conn_done()`, and a `thread` (the reactor's) for callers that join."""
+
+    def __init__(self, coordinator, host: str, port: int, lock,
+                 reactor: Reactor | None = None):
+        self.coord = coordinator
+        self.lock = lock
+        if reactor is None:
+            reactor = Reactor(
+                executor_threads=int(
+                    coordinator.configs.get("reactor_executor_threads")
+                )
+            )
+            self._owns_reactor = True
+        else:
+            self._owns_reactor = False
+        self.reactor = reactor
+        self.thread = reactor.thread
+        self._count_mutex = threading.Lock()
+        self.active_connections = 0
+        self.conns: set = set()
+        self._closed = False
+        self.srv = socket.create_server((host, port))
+        self.srv.listen(64)
+        self.srv.setblocking(False)
+        self.reactor.in_loop(
+            lambda: self.reactor.register(
+                self.srv, EVENT_READ, self._listener_readable
+            )
+        )
+
+    # -- socket-compatible surface --------------------------------------------
+    def getsockname(self):
+        return self.srv.getsockname()
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        done = threading.Event()
+
+        def _do():
+            try:
+                self.reactor.unregister(self.srv)
+            except (KeyError, OSError, ValueError):
+                pass
+            try:
+                self.srv.close()
+            except OSError:
+                pass
+            for c in list(self.conns):
+                self._close_conn(c)
+            done.set()
+            if self._owns_reactor:
+                self.reactor.stop()
+
+        self.reactor.in_loop(_do)
+        done.wait(2.0)
+        if self._owns_reactor:
+            self.reactor.thread.join(2.0)
+
+    def conn_done(self) -> None:
+        with self._count_mutex:
+            self.active_connections -= 1
+
+    # -- accept ---------------------------------------------------------------
+    def _listener_readable(self, sock, mask) -> None:
+        while True:
+            try:
+                conn, _addr = sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            conn.setblocking(False)
+            with self._count_mutex:
+                self.active_connections += 1
+            c = _PgConn(conn, self)
+            self.conns.add(c)
+            self.reactor.register(
+                conn, EVENT_READ,
+                lambda s, m, c=c: self._conn_event(c, m),
+            )
+            # startup budget, as in the threaded run(): a dialed-but-silent
+            # connection may not camp on its max_connections slot forever
+            c.startup_timer = self.reactor.call_later(
+                30.0, lambda c=c: self._startup_expired(c)
+            )
+
+    def _startup_expired(self, c: _PgConn) -> None:
+        if not c.closed and c.phase == "startup":
+            self._close_conn(c)
+
+    # -- readiness ------------------------------------------------------------
+    def _conn_event(self, c: _PgConn, mask: int) -> None:
+        if mask & EVENT_READ:
+            self._conn_readable(c)
+        if not c.closed and (mask & EVENT_WRITE):
+            self._conn_writable(c)
+
+    def _conn_readable(self, c: _PgConn) -> None:
+        got = False
+        while True:
+            try:
+                chunk = c.sock.recv(65536)
+            except BlockingIOError:
+                break
+            except OSError:
+                chunk = b""
+            if chunk == b"":
+                c.eof = True
+                break
+            got = True
+            c.inbuf += chunk
+        if c.closed:
+            return
+        if got and c.idle_timer is not None:
+            c.idle_timer.cancel()
+            c.idle_timer = None
+        if c.stream is not None:
+            # client traffic / EOF during SUBSCRIBE ends the stream (the
+            # pump notices); nothing is parsed until the stream finishes
+            self._pump_stream(c)
+            return
+        self._parse_frames(c)
+        self._pump(c)
+
+    def _conn_writable(self, c: _PgConn) -> None:
+        while c.out:
+            head = c.out[0]
+            view = memoryview(head)[c.out_off:] if c.out_off else head
+            try:
+                n = c.sock.send(view)
+            except BlockingIOError:
+                break
+            except OSError:
+                self._close_conn(c)
+                return
+            if n <= 0:
+                break
+            c.out_off += n
+            c.out_len -= n
+            if c.out_off >= len(head):
+                c.out.pop(0)
+                c.out_off = 0
+        self._set_write_interest(c, bool(c.out))
+        if not c.out:
+            if c.closing:
+                self._close_conn(c)
+            elif c.stream is not None:
+                self._pump_stream(c)  # drained below the watermark: refill
+
+    def _set_write_interest(self, c: _PgConn, want: bool) -> None:
+        if c.closed or want == c.want_write:
+            return
+        c.want_write = want
+        events = EVENT_READ | (EVENT_WRITE if want else 0)
+        try:
+            self.reactor.modify(
+                c.sock, events, lambda s, m, c=c: self._conn_event(c, m)
+            )
+        except (KeyError, OSError, ValueError):
+            pass
+
+    def _enqueue_out(self, c: _PgConn, data: bytes) -> None:
+        if not data or c.closed:
+            return
+        c.out.append(data)
+        c.out_len += len(data)
+        self._conn_writable(c)  # opportunistic immediate flush
+
+    def _flush_staged(self, c: _PgConn) -> None:
+        staged = c.shim.staged
+        if staged:
+            c.shim.staged = []
+            self._enqueue_out(c, b"".join(staged))
+
+    # -- framing --------------------------------------------------------------
+    def _parse_frames(self, c: _PgConn) -> None:
+        import struct
+
+        while not c.closed and not c.closing:
+            if c.phase == "startup":
+                if len(c.inbuf) < 4:
+                    return
+                (n,) = struct.unpack(">I", bytes(c.inbuf[:4]))
+                if n < 4 or n > _MAX_FRAME:
+                    self._close_conn(c)
+                    return
+                if len(c.inbuf) < n:
+                    return
+                body = bytes(c.inbuf[4:n])
+                del c.inbuf[:n]
+                verdict = c.pg._startup_packet(body)
+                self._flush_staged(c)
+                if verdict == "more":
+                    continue
+                if verdict == "ready":
+                    c.phase = "ready"
+                    if c.startup_timer is not None:
+                        c.startup_timer.cancel()
+                        c.startup_timer = None
+                    # the first ReadyForQuery, which the threaded run()
+                    # sends right after _startup() returns
+                    c.pg._send_ready()
+                    self._flush_staged(c)
+                    continue
+                self._start_close(c)
+                return
+            if len(c.inbuf) < 5:
+                return
+            tag = bytes(c.inbuf[0:1])
+            (n,) = struct.unpack(">I", bytes(c.inbuf[1:5]))
+            if n < 4 or n > _MAX_FRAME:
+                self._close_conn(c)
+                return
+            if len(c.inbuf) < 1 + n:
+                return
+            payload = bytes(c.inbuf[5 : 1 + n])
+            del c.inbuf[: 1 + n]
+            c.frames.append((tag, payload))
+
+    # -- command pump (one executor job per connection at a time) --------------
+    def _pump(self, c: _PgConn) -> None:
+        if c.closed or c.closing or c.job_running or c.stream is not None:
+            return
+        if c.phase != "ready":
+            if c.eof and not c.inbuf:
+                self._close_conn(c)
+            return
+        if c.frames:
+            tag, payload = c.frames.pop(0)
+            c.job_running = True
+            if c.idle_timer is not None:
+                c.idle_timer.cancel()
+                c.idle_timer = None
+            self.reactor.submit(
+                lambda pg=c.pg, t=tag, p=payload: pg.dispatch(t, p),
+                lambda res, exc, c=c: self._job_done(c, res, exc),
+            )
+            return
+        if c.eof:
+            self._close_conn(c)
+            return
+        self._arm_idle(c)
+
+    def _job_done(self, c: _PgConn, keep_open, exc) -> None:
+        c.job_running = False
+        self._flush_staged(c)
+        if c.closed:
+            ps = c.pg.pending_stream
+            if ps is not None:  # job opened a stream on a dead connection
+                c.pg.pending_stream = None
+                self.reactor.submit(
+                    lambda pg=c.pg, s=ps["sub"]: pg._teardown_sub(s, "cancelled"),
+                    lambda res, exc2: None,
+                )
+            return
+        if exc is not None:
+            self._start_close(c)
+            return
+        ps = c.pg.pending_stream
+        if ps is not None:
+            self._begin_stream(c, ps)
+            return
+        if keep_open is False:
+            self._start_close(c)
+            return
+        self._pump(c)
+
+    def _arm_idle(self, c: _PgConn) -> None:
+        if c.idle_timer is not None or c.inbuf:
+            return
+        idle_ms = int(
+            c.pg.session.get("idle_in_transaction_session_timeout")
+        )
+        if idle_ms <= 0:
+            return
+        c.idle_timer = self.reactor.call_later(
+            idle_ms / 1000.0, lambda c=c: self._idle_fire(c)
+        )
+
+    def _idle_fire(self, c: _PgConn) -> None:
+        c.idle_timer = None
+        if (
+            c.closed or c.closing or c.job_running
+            or c.frames or c.inbuf or c.stream is not None
+        ):
+            return
+        c.pg._send_idle_timeout_error()
+        self._flush_staged(c)
+        self._start_close(c)
+
+    # -- SUBSCRIBE streaming ---------------------------------------------------
+    def _begin_stream(self, c: _PgConn, ps: dict) -> None:
+        listener = lambda c=c: self.reactor.call_soon(  # noqa: E731
+            lambda: self._pump_stream(c)
+        )
+        c.stream = {
+            "sub": ps["sub"],
+            "ps": ps,
+            "delivered": 0,
+            "last_activity": time.monotonic(),
+            "idle_ms": int(
+                c.pg.session.get("idle_in_transaction_session_timeout")
+            ),
+            "listener": listener,
+            "timer": None,
+            "ending": None,
+            "pumping": False,
+        }
+        self.coord.fanout.add_listener(listener)
+        self._stream_tick(c)
+
+    def _stream_tick(self, c: _PgConn) -> None:
+        st = c.stream
+        if st is None or c.closed:
+            return
+        self._pump_stream(c)
+        st = c.stream
+        if st is not None and st["ending"] is None:
+            st["timer"] = self.reactor.call_later(
+                SWEEP_S, lambda c=c: self._stream_tick(c)
+            )
+
+    def _pump_stream(self, c: _PgConn) -> None:
+        st = c.stream
+        if st is None or c.closed or st["ending"] is not None or st["pumping"]:
+            return
+        sub = st["sub"]
+        if c.eof:
+            # client went away mid-stream: release the read hold, no bytes
+            self._end_stream(c, "eof")
+            return
+        if c.inbuf or c.frames:
+            # any client message means "stop subscribing": clean CopyDone,
+            # then the buffered message dispatches (threaded run() ditto)
+            self._end_stream(c, "clean")
+            return
+        if c.pg.session.cancelled.is_set():
+            self._end_stream(
+                c, QueryCanceled("canceling statement due to user request")
+            )
+            return
+        drained = False
+        st["pumping"] = True  # _enqueue_out's flush may re-enter via writable
+        try:
+            while c.out_len < HIGH_WATER:
+                try:
+                    frame = sub.pop_frame("pgcopy", timeout=0.0)
+                except SqlError as e:  # shed: 53400 ends the COPY
+                    self._end_stream(c, e)
+                    return
+                if frame is None:
+                    drained = True
+                    break
+                st["delivered"] += frame.count
+                st["last_activity"] = time.monotonic()
+                self._enqueue_out(c, frame.data)
+                if c.closed or c.stream is not st:
+                    return
+        finally:
+            st["pumping"] = False
+        if drained and sub.state != "active":
+            self._end_stream(c, "clean")  # dropped: prefix done, end cleanly
+            return
+        idle_ms = st["idle_ms"]
+        if (
+            idle_ms > 0
+            and (time.monotonic() - st["last_activity"]) > idle_ms / 1000.0
+        ):
+            self.coord.overload.bump("idle_timeouts")
+            self._end_stream(
+                c,
+                IdleTimeout(
+                    "terminating SUBSCRIBE due to idle-in-transaction "
+                    "session timeout"
+                ),
+            )
+
+    def _end_stream(self, c: _PgConn, how) -> None:
+        """Terminal transition for a stream: `how` is 'clean' (CopyDone +
+        CommandComplete), 'eof' (silent teardown), or a SqlError (57014 /
+        57P05 / 53400 ErrorResponse). Teardown takes the coordinator lock,
+        so the tail runs as ONE executor job emitting the same byte
+        sequence the threaded `_stream_subscription` would."""
+        st = c.stream
+        if st is None or st["ending"] is not None:
+            return
+        st["ending"] = how
+        self.coord.fanout.remove_listener(st["listener"])
+        if st["timer"] is not None:
+            st["timer"].cancel()
+            st["timer"] = None
+        ps = st["ps"]
+        delivered = st["delivered"]
+        c.pg.pending_stream = None
+        sub = st["sub"]
+
+        def job(pg=c.pg):
+            pg._teardown_sub(sub, "cancelled")
+            if how == "eof":
+                return False
+            if isinstance(how, SqlError):
+                pg._send_error(how.sqlstate, str(how))
+            else:
+                pg._send(_msg(b"c", b""))
+                pg._send(_msg(b"C", _cstr(f"SUBSCRIBE {delivered}")))
+            # results trailing the SUBSCRIBE in the same script, then the
+            # deferred ReadyForQuery — the inline path's ordering
+            pg._send_results(ps["rest"], ps["with_description"])
+            if pg.pending_stream is not None:
+                pg.pending_stream["send_ready"] = ps["send_ready"]
+            elif ps["send_ready"]:
+                pg._send_ready()
+            return True
+
+        c.job_running = True
+        self.reactor.submit(
+            job, lambda res, exc, c=c: self._stream_job_done(c, res, exc)
+        )
+
+    def _stream_job_done(self, c: _PgConn, keep_open, exc) -> None:
+        c.job_running = False
+        c.stream = None
+        self._flush_staged(c)
+        if c.closed:
+            return
+        if exc is not None or keep_open is False:
+            self._start_close(c)
+            return
+        if c.pg.pending_stream is not None:
+            self._begin_stream(c, c.pg.pending_stream)
+            return
+        self._parse_frames(c)
+        self._pump(c)
+
+    # -- teardown --------------------------------------------------------------
+    def _start_close(self, c: _PgConn) -> None:
+        """Close after the out-queue drains (the error/terminal bytes must
+        reach the wire first)."""
+        if c.closed:
+            return
+        c.closing = True
+        if not c.out:
+            self._close_conn(c)
+
+    def _close_conn(self, c: _PgConn) -> None:
+        if c.closed:
+            return
+        c.closed = True
+        for t in (c.idle_timer, c.startup_timer):
+            if t is not None:
+                t.cancel()
+        st = c.stream
+        if st is not None:
+            self.coord.fanout.remove_listener(st["listener"])
+            if st["timer"] is not None:
+                st["timer"].cancel()
+            if st["ending"] is None:
+                # stream aborted without its terminal job: still release
+                # the subscription's read hold
+                sub = st["sub"]
+                self.reactor.submit(
+                    lambda pg=c.pg, s=sub: pg._teardown_sub(s, "cancelled"),
+                    lambda res, exc: None,
+                )
+            c.stream = None
+        self.conns.discard(c)
+        try:
+            self.reactor.unregister(c.sock)
+        except (KeyError, OSError, ValueError):
+            pass
+        try:
+            c.sock.close()
+        except OSError:
+            pass
+        self.coord.cancel_keys.pop(c.pg.pid, None)
+        self.conn_done()
+
+
+def serve_pgwire_reactor(coordinator, host: str, port: int, lock,
+                         reactor: Reactor | None = None) -> ReactorPgServer:
+    return ReactorPgServer(coordinator, host, port, lock, reactor=reactor)

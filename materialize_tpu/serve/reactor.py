@@ -1,0 +1,233 @@
+"""Reactor core: a single-threaded readiness loop for the serving plane.
+
+The reference runs its serving plane on tokio — every pgwire/HTTP
+connection is a task on an event loop, not an OS thread
+(src/environmentd/src/server.rs `serve`). This module is that loop,
+built on `selectors` + nonblocking sockets:
+
+- ONE thread runs `select()` and every readiness callback. Callbacks never
+  block: no `sendall`, no blocking `recv` (only readiness-driven reads in
+  `*_readable` handlers), no coordinator-lock acquisition — the mzlint
+  `reactor-discipline` pass enforces this textually over `serve/`.
+
+- Work that must block (coordinator commands behind the AdmissionGates,
+  SUBSCRIBE teardown taking the command lock) is shipped to a small
+  executor pool via `submit(fn, done)`; `done(result, exc)` runs back on
+  the reactor thread. The coordinator command path thus stays threaded —
+  exactly the reference's split between the tokio serving runtime and the
+  coordinator's dedicated thread (coord intro docs: "off the main thread").
+
+- Cross-thread wakeups ride a socketpair: `call_soon` from any thread
+  appends to the ready queue and writes one byte, so a coordinator tick
+  can nudge streaming connections without touching the selector.
+
+Timers are a heap (`call_later`), used for idle/startup budgets and the
+streaming cancel/idle sweep — the reactor analogue of the per-thread
+`settimeout` budgets the threaded frontends use.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import queue
+import selectors
+import socket
+import threading
+import time
+from collections import deque
+
+EVENT_READ = selectors.EVENT_READ
+EVENT_WRITE = selectors.EVENT_WRITE
+
+
+class Timer:
+    """Handle for one `call_later` deadline; `cancel()` is idempotent."""
+
+    __slots__ = ("when", "fn", "cancelled")
+
+    def __init__(self, when: float, fn):
+        self.when = when
+        self.fn = fn
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class Reactor:
+    """The event loop. One per process is the intended shape (both
+    frontends share it via the `reactor=` parameter), but tests spin up
+    as many as they like — each owns its thread, selector, and pool."""
+
+    def __init__(self, executor_threads: int = 8, name: str = "mzt-reactor"):
+        self._sel = selectors.DefaultSelector()
+        self._mutex = threading.Lock()  # guards _ready/_timers from foreign threads
+        self._ready: deque = deque()
+        self._timers: list = []  # heap of (when, seq, Timer)
+        self._timer_seq = itertools.count()
+        self._stopping = False
+        self._jobs: queue.SimpleQueue = queue.SimpleQueue()
+        r, w = socket.socketpair()
+        r.setblocking(False)
+        w.setblocking(False)
+        self._wake_r, self._wake_w = r, w
+        self._sel.register(r, EVENT_READ, self._wakeup_readable)
+        n = max(1, int(executor_threads))
+        self._workers = [
+            threading.Thread(
+                target=self._worker, daemon=True, name=f"{name}-exec-{i}"
+            )
+            for i in range(n)
+        ]
+        for t in self._workers:
+            t.start()
+        self.thread = threading.Thread(target=self._run, daemon=True, name=name)
+        self.thread.start()
+
+    # -- scheduling (any thread) -----------------------------------------------
+    def call_soon(self, fn) -> None:
+        with self._mutex:
+            self._ready.append(fn)
+        self._wake()
+
+    def call_later(self, delay: float, fn) -> Timer:
+        t = Timer(time.monotonic() + max(0.0, delay), fn)
+        with self._mutex:
+            heapq.heappush(self._timers, (t.when, next(self._timer_seq), t))
+        self._wake()
+        return t
+
+    def in_loop(self, fn) -> None:
+        """Run `fn` on the reactor thread — immediately when already there
+        (selector mutation from a callback), else on the next spin."""
+        if threading.current_thread() is self.thread:
+            fn()
+        else:
+            self.call_soon(fn)
+
+    def submit(self, fn, done) -> None:
+        """Run blocking `fn()` on the executor pool; `done(result, exc)`
+        runs back on the reactor thread."""
+        self._jobs.put((fn, done))
+
+    # -- selector surface (reactor thread only) --------------------------------
+    def register(self, sock, events: int, cb) -> None:
+        self._sel.register(sock, events, cb)
+
+    def modify(self, sock, events: int, cb) -> None:
+        self._sel.modify(sock, events, cb)
+
+    def unregister(self, sock) -> None:
+        self._sel.unregister(sock)
+
+    # -- lifecycle -------------------------------------------------------------
+    def stop(self) -> None:
+        with self._mutex:
+            if self._stopping:
+                return
+            self._stopping = True
+        for _ in self._workers:
+            self._jobs.put(None)
+        self._wake()
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # pipe full (a wakeup is already pending) or shut down
+
+    def _wakeup_readable(self, sock, mask) -> None:
+        try:
+            while sock.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def _worker(self) -> None:
+        while True:
+            item = self._jobs.get()
+            if item is None:
+                return
+            fn, done = item
+            result, exc = None, None
+            try:
+                result = fn()
+            except Exception as e:  # surfaced to done() on the loop; a
+                # simulated crash (CrashPointReached is BaseException)
+                # kills the worker like a real crash would
+                exc = e
+            self.call_soon(lambda d=done, r=result, x=exc: d(r, x))
+
+    # -- the loop --------------------------------------------------------------
+    def _next_timeout(self) -> float:
+        with self._mutex:
+            if self._ready:
+                return 0.0
+            while self._timers and self._timers[0][2].cancelled:
+                heapq.heappop(self._timers)
+            if not self._timers:
+                return 1.0  # bounded so stop() is always observed
+            return min(1.0, max(0.0, self._timers[0][0] - time.monotonic()))
+
+    def _run(self) -> None:
+        while True:
+            with self._mutex:
+                if self._stopping:
+                    break
+            try:
+                events = self._sel.select(self._next_timeout())
+            except OSError:
+                events = []
+            for key, mask in events:
+                try:
+                    key.data(key.fileobj, mask)
+                except Exception:
+                    # a callback fault must not take down the loop; the
+                    # connection owning the callback cleans itself up via
+                    # its own error paths
+                    pass
+            self._drain_ready()
+            self._fire_timers()
+        self._shutdown()
+
+    def _drain_ready(self) -> None:
+        while True:
+            with self._mutex:
+                if not self._ready:
+                    return
+                fn = self._ready.popleft()
+            try:
+                fn()
+            except Exception:
+                pass
+
+    def _fire_timers(self) -> None:
+        now = time.monotonic()
+        while True:
+            with self._mutex:
+                if not self._timers or self._timers[0][0] > now:
+                    return
+                _, _, t = heapq.heappop(self._timers)
+            if t.cancelled:
+                continue
+            try:
+                t.fn()
+            except Exception:
+                pass
+
+    def _shutdown(self) -> None:
+        for key in list(self._sel.get_map().values()):
+            try:
+                self._sel.unregister(key.fileobj)
+            except (KeyError, OSError):
+                pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+        try:
+            self._sel.close()
+        except OSError:
+            pass

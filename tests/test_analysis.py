@@ -626,6 +626,113 @@ def test_collective_coherence_flags_host_pulls_in_plane_functions():
     assert len(fs) == 2 and "np.asarray" in msgs and "io_callback" in msgs, fs
 
 
+# -- reactor-discipline -------------------------------------------------------
+
+def test_reactor_discipline_flags_blocking_calls_on_the_loop():
+    src = """
+        import time
+
+        class Server:
+            def _conn_event(self, c, mask):
+                c.sock.sendall(b"x")
+                time.sleep(0.1)
+                with self.lock:
+                    self.coord.tick()
+    """
+    fs = run(proj(materialize_tpu__serve__bad=src), "reactor-discipline")
+    msgs = " | ".join(f.message for f in fs)
+    assert len(fs) == 3, fs
+    assert "sendall" in msgs and "time.sleep" in msgs and "with lock" in msgs.replace("'with lock:'", "with lock"), msgs
+
+
+def test_reactor_discipline_flags_recv_outside_readiness_handler():
+    src = """
+        class Server:
+            def _pump(self, c):
+                return c.sock.recv(4096)
+
+            def _conn_readable(self, c, mask):
+                return c.sock.recv(4096)
+    """
+    fs = run(proj(materialize_tpu__serve__bad=src), "reactor-discipline")
+    assert len(fs) == 1 and "readiness" in fs[0].message, fs
+
+
+def test_reactor_discipline_requires_nonblocking_sockets():
+    src = """
+        import socket
+
+        class Server:
+            def __init__(self, host, port):
+                self.srv = socket.create_server((host, port))
+
+            def _listener_readable(self, sock, mask):
+                c, _ = sock.accept()
+                c.setblocking(True)
+    """
+    fs = run(proj(materialize_tpu__serve__bad=src), "reactor-discipline")
+    msgs = " | ".join(f.message for f in fs)
+    assert len(fs) == 3, fs  # two never-nonblocking fns + setblocking(True)
+    assert "setblocking(False)" in msgs and "setblocking(True)" in msgs, msgs
+
+
+def test_reactor_discipline_quiet_on_disciplined_reactor():
+    src = """
+        import socket
+        import threading
+
+        class Server:
+            def __init__(self, host, port):
+                self._mutex = threading.Lock()
+                self.srv = socket.create_server((host, port))
+                self.srv.setblocking(False)
+
+            def _listener_readable(self, sock, mask):
+                while True:
+                    try:
+                        c, _ = sock.accept()
+                    except BlockingIOError:
+                        return
+                    c.setblocking(False)
+
+            def _conn_readable(self, c, mask):
+                data = c.sock.recv(65536)
+                with self._mutex:
+                    self.nbytes += len(data)
+
+            def _job_done(self, c, result, exc):
+                self.reactor.submit(lambda: self.dispatch(c), self._job_done)
+    """
+    fs = run(proj(materialize_tpu__serve__good=src), "reactor-discipline")
+    assert not fs, fs
+
+
+def test_reactor_discipline_scoped_to_serve_only():
+    src = """
+        class Handler:
+            def handle(self):
+                self.sock.sendall(b"x")
+                with self.lock:
+                    self.coord.tick()
+    """
+    fs = run(proj(materialize_tpu__frontend__h=src), "reactor-discipline")
+    assert not fs, fs
+
+
+def test_listener_hygiene_exempts_nonblocking_readiness_accept():
+    src = """
+        def _listener_readable(sock, mask):
+            while True:
+                try:
+                    c, _ = sock.accept()
+                except BlockingIOError:
+                    return
+                c.setblocking(False)
+    """
+    fs = run(proj(materialize_tpu__serve__loop=src), "listener-hygiene")
+    assert not fs, fs
+
+
 # -- suppressions -------------------------------------------------------------
 
 

@@ -46,11 +46,14 @@ def _identical(got, want):
 # -- registry mechanics -------------------------------------------------------
 
 
-def test_registry_registers_all_four_kernels():
+def test_registry_registers_every_kernel():
+    # the PR 15 tick-path trio plus the PR 16 device-mesh routing pair
     assert kernels.registered_kernels() == [
+        "bucket_rank",
         "multi_take",
         "probe",
         "probe2",
+        "route_dest",
         "run_sum",
     ]
 
